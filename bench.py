@@ -1,0 +1,82 @@
+"""Benchmark: 3-D heat diffusion cell-updates/s per chip.
+
+Headline metric from BASELINE.md: the reference achieves ≈0.95e9
+cell-updates/s per GPU (P100, Float64 CuArray broadcasts, incl. in-situ vis —
+`reference README.md:163-167`, 510³ global / 2x2x2 x 256³ local, nt=1e5).
+
+Here: 256³ per chip (BASELINE.json config "diffusion3D 256³/chip"), whole time
+loop compiled as one XLA program (lax.fori_loop + inline halo exchange).
+Prints ONE JSON line.
+
+Usage: python bench.py            (real TPU, f32, 256³/chip)
+       python bench.py --cpu      (small smoke run on CPU)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    cpu = "--cpu" in sys.argv
+    if cpu:
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import init_diffusion3d, make_run
+
+    if cpu:
+        nx = 64
+        nt = 30
+        dims = (2, 2, 2)
+    else:
+        nx = 256
+        nt = 400
+        nd = len(jax.devices())
+        dims = tuple(int(d) for d in igg.dims_create(nd, (0, 0, 0)))
+
+    igg.init_global_grid(nx, nx, nx, dimx=dims[0], dimy=dims[1], dimz=dims[2],
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    n_chips = int(np.prod(dims))
+    T, Cp, p = init_diffusion3d(dtype=np.float32)
+    chunk = max(1, nt // 4)
+    run = make_run(p, nt_chunk=chunk)
+
+    # warmup/compile
+    jax.block_until_ready(run(T, Cp))
+
+    igg.tic()
+    Tc = T
+    steps = 0
+    while steps < nt:
+        Tc = run(Tc, Cp)
+        steps += chunk
+    jax.block_until_ready(Tc)
+    t = igg.toc()
+
+    cells = float(igg.nx_g()) * float(igg.ny_g()) * float(igg.nz_g())
+    rate = cells * steps / t
+    rate_per_chip = rate / n_chips
+    baseline = 0.95e9  # per-GPU reference throughput (BASELINE.md)
+    print(json.dumps({
+        "metric": "diffusion3D_cell_updates_per_s_per_chip",
+        "value": rate_per_chip,
+        "unit": "cell-updates/s/chip",
+        "vs_baseline": rate_per_chip / baseline,
+    }))
+    igg.finalize_global_grid()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""implicitglobalgrid_tpu — a TPU-native implicit-global-grid framework.
+
+A from-scratch re-design of the capabilities of ImplicitGlobalGrid.jl
+(reference mounted at `/root/reference`; structural analysis in `SURVEY.md`)
+for TPUs on JAX/XLA: distributed stencil computations on an implicit global
+grid by Cartesian domain decomposition over a `jax.sharding.Mesh`, with halo
+exchange lowered to per-axis `lax.ppermute` collectives riding the ICI mesh.
+
+Public API — the reference's 13 exported symbols
+(`/root/reference/src/ImplicitGlobalGrid.jl:10-22`), Python-style (functional,
+no `!`):
+
+    init_global_grid, finalize_global_grid, update_halo, gather,
+    select_device, nx_g, ny_g, nz_g, x_g, y_g, z_g, tic, toc
+
+plus TPU-native extensions: `local_update_halo` (the local-view exchange for
+use inside your own `shard_map`), `zeros_g`/`ones_g`/`full_g`/`device_put_g`
+(sharded allocation), `coords_g`/`x_g_vec` (vectorized coordinates for ICs),
+`gather_interior`, `barrier`, stencil helpers (`d_xa` … `inn`), and the
+`Field` wrapper for per-field halowidths.
+
+Usage (compare reference `examples/diffusion3D_multicpu_novis.jl`)::
+
+    import implicitglobalgrid_tpu as igg
+    me, dims, nprocs, coords, mesh = igg.init_global_grid(nx, ny, nz)
+    dx = lx / (igg.nx_g() - 1)
+    T = igg.zeros_g()                     # one sharded array; shards = local blocks
+    ...
+    T = igg.update_halo(T)                # one ppermute pair per axis, jitted
+    igg.finalize_global_grid()
+"""
+
+from .parallel.grid import (
+    init_global_grid, finalize_global_grid, select_device,
+)
+from .parallel.topology import (
+    AXIS_NAMES, NDIMS, PROC_NULL, GlobalGrid,
+    global_grid, get_global_grid, grid_is_initialized, check_initialized,
+    neighbors_table, ol, dims_create,
+)
+from .ops.halo import update_halo, local_update_halo, DEFAULT_DIMS_ORDER
+from .ops.gather import gather, gather_interior
+from .ops.alloc import zeros_g, ones_g, full_g, device_put_g, sharding_of
+from .ops.fields import Field, wrap_field, extract, local_shape_of, stacked_shape
+from .ops.stencil import d_xa, d_ya, d_za, d_xi, d_yi, d_zi, inn
+from .tools import (
+    nx_g, ny_g, nz_g, x_g, y_g, z_g, x_g_vec, y_g_vec, z_g_vec, coords_g,
+)
+from .utils.timing import tic, toc, barrier
+from .utils import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    # reference 13-symbol API (src/ImplicitGlobalGrid.jl:10-22)
+    "init_global_grid", "finalize_global_grid", "update_halo", "gather",
+    "select_device", "nx_g", "ny_g", "nz_g", "x_g", "y_g", "z_g", "tic", "toc",
+    # TPU-native extensions
+    "local_update_halo", "gather_interior", "barrier",
+    "zeros_g", "ones_g", "full_g", "device_put_g", "sharding_of",
+    "Field", "wrap_field", "extract", "local_shape_of", "stacked_shape",
+    "x_g_vec", "y_g_vec", "z_g_vec", "coords_g",
+    "d_xa", "d_ya", "d_za", "d_xi", "d_yi", "d_zi", "inn",
+    # state/introspection
+    "AXIS_NAMES", "NDIMS", "PROC_NULL", "GlobalGrid", "global_grid",
+    "get_global_grid", "grid_is_initialized", "check_initialized",
+    "neighbors_table", "ol", "dims_create", "DEFAULT_DIMS_ORDER",
+    "exceptions",
+]

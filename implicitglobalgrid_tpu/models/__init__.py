@@ -1,0 +1,12 @@
+"""Model workloads built on the framework (the reference's `examples/` role,
+re-designed whole-loop-jitted for TPU)."""
+
+from .diffusion import (
+    DiffusionParams, init_diffusion3d, init_diffusion2d,
+    diffusion_step_local, make_step, make_run, run_diffusion,
+)
+
+__all__ = [
+    "DiffusionParams", "init_diffusion3d", "init_diffusion2d",
+    "diffusion_step_local", "make_step", "make_run", "run_diffusion",
+]

@@ -1,0 +1,184 @@
+"""3-D (and 2-D) heat diffusion — the framework's flagship workload.
+
+TPU-native re-design of the reference's canonical example
+(`/root/reference/examples/diffusion3D_multicpu_novis.jl:11-51`,
+`diffusion3D_multigpu_CuArrays_novis.jl:12-54`): Fourier-law fluxes +
+energy-conservation update + halo exchange every step.
+
+The TPU-first difference: instead of one dispatched broadcast per operation
+per step (the reference's hot loop, which its own README notes leaves >10x
+headroom, `README.md:167`), the ENTIRE time loop runs as one compiled XLA
+program — `lax.fori_loop` over the fused stencil update with the per-axis
+`ppermute` halo exchange inline (`run` below). XLA fuses flux computation,
+divergence, and update into a handful of kernels per step and overlaps the
+halo collectives with interior compute via its latency-hiding scheduler.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..ops.alloc import device_put_g, zeros_g
+from ..ops.fields import field_partition_spec
+from ..ops.halo import local_update_halo
+from ..ops.stencil import d_xa, d_xi, d_ya, d_yi, d_za, d_zi, inn
+from ..parallel.topology import check_initialized, global_grid
+from ..tools import coords_g, nx_g, ny_g, nz_g
+
+__all__ = ["DiffusionParams", "init_diffusion3d", "init_diffusion2d",
+           "diffusion_step_local", "make_step", "make_run", "run_diffusion"]
+
+
+@dataclass(frozen=True)
+class DiffusionParams:
+    """Physics/numerics constants (static: baked into the compiled program)."""
+    lam: float      # thermal conductivity
+    dt: float
+    dx: float
+    dy: float = 1.0
+    dz: float = 1.0
+
+
+def _gaussian(x, amp, cx, w=1.0):
+    import jax.numpy as jnp
+
+    return amp * jnp.exp(-(((x - cx) / w) ** 2))
+
+
+def init_diffusion3d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, lz=10.0,
+                     dtype=None):
+    """Build (T, Cp, params) with the reference example's initial conditions
+    (two Gaussian anomalies each,
+    `diffusion3D_multigpu_CuArrays_novis.jl:34-38`) as stacked sharded arrays.
+
+    The grid must be initialized; local size is the grid's ``(nx, ny, nz)``.
+    """
+    import jax.numpy as jnp
+
+    check_initialized()
+    dx = lx / (nx_g() - 1)
+    dy = ly / (ny_g() - 1)
+    dz = lz / (nz_g() - 1)
+    dt = min(dx * dx, dy * dy, dz * dz) * cp_min / lam / 8.1  # example :41
+
+    Tz = zeros_g(dtype=dtype)
+    x, y, z = coords_g(dx, dy, dz, Tz)
+    x, y, z = (jnp.asarray(np.asarray(v), dtype=Tz.dtype) for v in (x, y, z))
+    Cp = cp_min \
+        + 5 * jnp.exp(-((x - lx / 1.5) ** 2) - ((y - ly / 2) ** 2) - ((z - lz / 1.5) ** 2)) \
+        + 5 * jnp.exp(-((x - lx / 3.0) ** 2) - ((y - ly / 2) ** 2) - ((z - lz / 1.5) ** 2))
+    T = 100 * jnp.exp(-(((x - lx / 2) / 2) ** 2) - (((y - ly / 2) / 2) ** 2) - (((z - lz / 3.0) / 2) ** 2)) \
+        + 50 * jnp.exp(-(((x - lx / 2) / 2) ** 2) - (((y - ly / 2) / 2) ** 2) - (((z - lz / 1.5) / 2) ** 2))
+    T = device_put_g(jnp.broadcast_to(T, Tz.shape).astype(Tz.dtype))
+    Cp = device_put_g(jnp.broadcast_to(Cp, Tz.shape).astype(Tz.dtype))
+    return T, Cp, DiffusionParams(lam=lam, dt=dt, dx=dx, dy=dy, dz=dz)
+
+
+def init_diffusion2d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, dtype=None):
+    """2-D variant (BASELINE config: 2-D diffusion on a 2x2 mesh)."""
+    import jax.numpy as jnp
+
+    check_initialized()
+    gg = global_grid()
+    dx = lx / (nx_g() - 1)
+    dy = ly / (ny_g() - 1)
+    dt = min(dx * dx, dy * dy) * cp_min / lam / 4.1
+    Tz = zeros_g(tuple(int(n) for n in gg.nxyz[:2]), dtype=dtype)
+    x, y = coords_g(dx, dy, 1.0, Tz)[:2]
+    x, y = (jnp.asarray(np.asarray(v), dtype=Tz.dtype) for v in (x, y))
+    Cp = cp_min + 5 * jnp.exp(-((x - lx / 1.5) ** 2) - ((y - ly / 2) ** 2))
+    T = 100 * jnp.exp(-(((x - lx / 2) / 2) ** 2) - (((y - ly / 2) / 2) ** 2))
+    T = device_put_g(jnp.broadcast_to(T, Tz.shape).astype(Tz.dtype))
+    Cp = device_put_g(jnp.broadcast_to(Cp, Tz.shape).astype(Tz.dtype))
+    return T, Cp, DiffusionParams(lam=lam, dt=dt, dx=dx, dy=dy)
+
+
+def diffusion_step_local(T, Cp, p: DiffusionParams):
+    """One time step on a LOCAL block (use inside shard_map) — the reference
+    hot loop verbatim (`diffusion3D_multicpu_novis.jl:41-47`), fused by XLA:
+
+        q = -λ ∇T;   δT/δt = -∇·q / cₚ;   T += dt δT/δt;   update_halo(T)
+    """
+    if T.ndim == 3:
+        qx = -p.lam * d_xi(T) / p.dx
+        qy = -p.lam * d_yi(T) / p.dy
+        qz = -p.lam * d_zi(T) / p.dz
+        dTdt = (-d_xa(qx) / p.dx - d_ya(qy) / p.dy - d_za(qz) / p.dz) / inn(Cp)
+        T = T.at[1:-1, 1:-1, 1:-1].add(p.dt * dTdt)
+    else:
+        qx = -p.lam * d_xi(T) / p.dx
+        qy = -p.lam * d_yi(T) / p.dy
+        dTdt = (-d_xa(qx) / p.dx - d_ya(qy) / p.dy) / inn(Cp)
+        T = T.at[1:-1, 1:-1].add(p.dt * dTdt)
+    return local_update_halo(T)
+
+
+def make_step(p: DiffusionParams, ndim: int = 3):
+    """Controller-level jitted single step on stacked arrays:
+    ``T = step(T, Cp)``."""
+    import jax
+
+    check_initialized()
+    gg = global_grid()
+    spec = field_partition_spec(ndim)
+
+    def local(T, Cp):
+        return diffusion_step_local(T, Cp, p)
+
+    return jax.jit(jax.shard_map(
+        local, mesh=gg.mesh, in_specs=(spec, spec), out_specs=spec
+    ))
+
+
+# Compiled whole-loop programs, keyed by (grid epoch, params, chunk, ndim) —
+# same pattern as the halo exchange cache (ops/halo.py): jit caches by
+# function identity, so rebuilding the closure per call would recompile.
+_run_cache: dict = {}
+
+
+def make_run(p: DiffusionParams, nt_chunk: int, ndim: int = 3):
+    """Whole-loop runner: ONE compiled program advancing ``nt_chunk`` steps
+    (`lax.fori_loop` with the halo ppermutes inline) — the TPU-first
+    replacement for the reference's per-step dispatch loop. Cached across
+    calls per grid epoch."""
+    import jax
+    from jax import lax
+
+    check_initialized()
+    gg = global_grid()
+    key = (gg.epoch, p, int(nt_chunk), int(ndim))
+    fn = _run_cache.get(key)
+    if fn is not None:
+        return fn
+    if _run_cache and next(iter(_run_cache))[0] != gg.epoch:
+        _run_cache.clear()  # stale grids
+    spec = field_partition_spec(ndim)
+
+    def chunk(T, Cp):
+        return lax.fori_loop(
+            0, nt_chunk, lambda i, Tc: diffusion_step_local(Tc, Cp, p), T
+        )
+
+    fn = jax.jit(jax.shard_map(
+        chunk, mesh=gg.mesh, in_specs=(spec, spec), out_specs=spec
+    ))
+    _run_cache[key] = fn
+    return fn
+
+
+def run_diffusion(T, Cp, p: DiffusionParams, nt: int, *, nt_chunk: int = 100):
+    """Advance ``nt`` steps, compiling at most two chunk sizes."""
+    import jax
+
+    ndim = T.ndim
+    full_chunks, rem = divmod(nt, nt_chunk)
+    if full_chunks:
+        run = make_run(p, nt_chunk, ndim)
+        for _ in range(full_chunks):
+            T = run(T, Cp)
+    if rem:
+        run_r = make_run(p, rem, ndim)
+        T = run_r(T, Cp)
+    return jax.block_until_ready(T)

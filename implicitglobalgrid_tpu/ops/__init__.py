@@ -1,0 +1,1 @@
+"""ops subpackage of implicitglobalgrid_tpu."""

@@ -1,0 +1,66 @@
+"""Allocation of global (stacked) fields with the grid's sharding.
+
+The reference's users allocate plain per-rank arrays (`zeros(nx, ny, nz)`,
+e.g. `/root/reference/examples/diffusion3D_multicpu_novis.jl:26-31`). The
+TPU-native analog allocates ONE sharded `jax.Array` whose per-device shards
+are those rank-local blocks; memory lives in each chip's HBM from the start
+(no host round-trip). Pass the LOCAL block shape — exactly the shape a
+reference user would pass — including staggering (`zeros_g((nx+1, ny, nz))`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..parallel.topology import check_initialized, global_grid
+from ..utils.exceptions import InvalidArgumentError
+from .fields import field_partition_spec, stacked_shape
+
+__all__ = ["zeros_g", "ones_g", "full_g", "sharding_of", "device_put_g"]
+
+
+def _default_local_shape():
+    gg = global_grid()
+    return tuple(int(n) for n in gg.nxyz)
+
+
+def sharding_of(ndim: int):
+    """NamedSharding that lays a ``ndim``-D stacked array over the grid mesh."""
+    import jax
+
+    check_initialized()
+    return jax.sharding.NamedSharding(global_grid().mesh, field_partition_spec(ndim))
+
+
+def full_g(local_shape=None, fill_value=0.0, dtype=None):
+    """Stacked global array with every shard a ``local_shape`` block of
+    ``fill_value``. ``local_shape=None`` uses the grid's ``(nx, ny, nz)``."""
+    import jax.numpy as jnp
+
+    check_initialized()
+    if local_shape is None:
+        local_shape = _default_local_shape()
+    local_shape = tuple(int(s) for s in local_shape)
+    if len(local_shape) < 1 or len(local_shape) > 3:
+        raise InvalidArgumentError("local_shape must have 1 to 3 dimensions.")
+    shape = stacked_shape(local_shape)
+    return jnp.full(shape, fill_value, dtype=dtype, device=sharding_of(len(shape)))
+
+
+def zeros_g(local_shape=None, dtype=None):
+    """`zeros(nx, ny, nz)` analog (reference example
+    `diffusion3D_multicpu_novis.jl:26`)."""
+    return full_g(local_shape, 0.0, dtype)
+
+
+def ones_g(local_shape=None, dtype=None):
+    return full_g(local_shape, 1.0, dtype)
+
+
+def device_put_g(A):
+    """Shard a host/replicated array ``A`` (stacked layout) over the grid mesh."""
+    import jax
+
+    check_initialized()
+    A = np.asarray(A) if not hasattr(A, "dtype") else A
+    return jax.device_put(A, sharding_of(A.ndim))

@@ -1,0 +1,202 @@
+"""Field abstraction: array + per-field halo widths.
+
+TPU-native analog of the reference's field layer (`/root/reference/src/shared.jl:43-55,133-148`):
+a "field" is a NamedTuple ``(A, halowidths)``; plain arrays are auto-wrapped
+with the grid-default halowidths (`shared.jl:139-143`); pytrees of arrays take
+the role of CellArrays (`shared.jl:133-137` extract) — struct-of-arrays is the
+native JAX layout, so `extract` simply flattens the pytree leaves.
+
+Two array layouts are understood everywhere:
+
+- **stacked/global layout** — one `jax.Array` of shape ``dims * local_shape``
+  sharded over the mesh; each device shard is exactly the reference's
+  rank-local array (overlap cells duplicated between neighbors). This is the
+  controller-side handle users hold between jitted steps.
+- **local layout** — the per-shard block seen inside `shard_map` (what
+  reference user code sees on every MPI rank).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Any
+
+import numpy as np
+
+from ..parallel.topology import (
+    AXIS_NAMES, NDIMS, check_initialized, global_grid, ol,
+)
+from ..utils.exceptions import IncoherentArgumentError, InvalidArgumentError
+
+__all__ = [
+    "Field", "wrap_field", "extract", "check_fields",
+    "local_shape_of", "stacked_shape", "field_partition_spec", "has_halo",
+]
+
+
+class Field(NamedTuple):
+    """A field = array + per-dimension halo widths (reference GGField,
+    `shared.jl:50`)."""
+    A: Any
+    halowidths: tuple
+
+
+def wrap_field(x, halowidths=None) -> Field:
+    """Wrap ``x`` into a `Field`, defaulting halowidths from the grid
+    (reference `wrap_field`, `shared.jl:139-143`). Accepts a `Field`, a
+    mapping with keys ``A``/``halowidths``, or a bare array."""
+    check_initialized()
+    if isinstance(x, Field):
+        if halowidths is not None:
+            raise InvalidArgumentError("halowidths given both in the field and as argument.")
+        return Field(x.A, tuple(int(h) for h in x.halowidths))
+    if isinstance(x, dict) and "A" in x:
+        hw = x.get("halowidths", halowidths)
+        return wrap_field(x["A"], hw)
+    if hasattr(x, "_fields") and "A" in getattr(x, "_fields", ()):  # NamedTuple-like
+        return wrap_field(x.A, getattr(x, "halowidths", halowidths))
+    if halowidths is None:
+        halowidths = tuple(int(h) for h in global_grid().halowidths)
+    elif np.isscalar(halowidths):
+        halowidths = (int(halowidths),) * NDIMS
+    else:
+        halowidths = tuple(int(h) for h in halowidths)
+        if len(halowidths) != NDIMS:
+            raise InvalidArgumentError(f"halowidths must have {NDIMS} entries.")
+    return Field(x, halowidths)
+
+
+def extract(x):
+    """Explode a pytree (dict/list/tuple of arrays — the CellArray analog,
+    reference `extract`/`bitsarrays`, `shared.jl:133-137,174-176`) into a flat
+    tuple of arrays/Fields."""
+    if isinstance(x, Field) or hasattr(x, "shape"):
+        return (x,)
+    if isinstance(x, dict):
+        if "A" in x:
+            return (x,)
+        return tuple(leaf for v in x.values() for leaf in extract(v))
+    if isinstance(x, (list, tuple)):
+        return tuple(leaf for v in x for leaf in extract(v))
+    raise InvalidArgumentError(f"Unsupported field type: {type(x)}.")
+
+
+# ---------------------------------------------------------------------------
+# Layout inference
+# ---------------------------------------------------------------------------
+
+def local_shape_of(shape) -> tuple:
+    """Infer the LOCAL (per-shard) shape of an array of ``shape``.
+
+    An array can be stacked/global (``shape[d] == dims[d] * l`` with ``l``
+    within one overlap of ``nxyz[d]`` — staggered fields differ from nxyz by at
+    most the extra staggering cells) or already local (``shape[d]`` itself
+    within one overlap of ``nxyz[d]``). Staggering tolerance mirrors the
+    reference's per-field overlap rule `ol(dim, A)` (`shared.jl:107`).
+    """
+    gg = global_grid()
+    local = []
+    for d in range(len(shape)):
+        s = int(shape[d])
+        dd = int(gg.dims[d]) if d < NDIMS else 1
+        n = int(gg.nxyz[d]) if d < NDIMS else 1
+        tol = int(gg.overlaps[d]) + 1 if d < NDIMS else 1
+        if dd == 1:
+            local.append(s)
+            continue
+        # Priority: exact/±1 local match (typical staggering, reference
+        # examples use nx±1) → stacked (within staggering tolerance) →
+        # loosely-staggered local. Ambiguity only arises for arrays a few
+        # cells big; stacked arrays are dims[d]-times larger.
+        if abs(s - n) <= 1:
+            local.append(s)
+        elif s % dd == 0 and abs(s // dd - n) <= tol:
+            local.append(s // dd)
+        elif abs(s - n) <= tol:
+            local.append(s)
+        else:
+            raise IncoherentArgumentError(
+                f"Array size {s} along dimension {d} is neither a stacked-global size "
+                f"(dims[{d}]={dd} times ~nxyz[{d}]={n}) nor a local size (~{n})."
+            )
+    return tuple(local)
+
+
+def stacked_shape(local_shape) -> tuple:
+    gg = global_grid()
+    return tuple(
+        int(gg.dims[d]) * int(local_shape[d]) if d < NDIMS else int(local_shape[d])
+        for d in range(len(local_shape))
+    )
+
+
+def field_partition_spec(ndim: int):
+    """PartitionSpec sharding the first ``ndim`` array axes over the mesh axes."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(*AXIS_NAMES[:ndim])
+
+
+def has_halo(local_shape, halowidths, dim: int) -> bool:
+    """A field participates in the halo update along ``dim`` iff its overlap is
+    at least twice its halowidth (reference `update_halo.jl:233,260,340`)."""
+    if dim >= len(local_shape):
+        return False
+    return ol(dim, local_shape) >= 2 * int(halowidths[dim])
+
+
+# ---------------------------------------------------------------------------
+# Input validation (reference `check_fields`, update_halo.jl:410-472)
+# ---------------------------------------------------------------------------
+
+def check_fields(fields) -> None:
+    """Validate fields for `update_halo` — the reference's seven checks
+    (`update_halo.jl:410-472`), minus the ones that cannot arise with JAX
+    arrays (bits-type elements, contiguity) and minus the all-same-type
+    restriction, which existed only for MPI staging-buffer reuse
+    (`update_halo.jl:465-471`) — XLA owns all buffers here.
+    """
+    # halowidth < 1 (reference :411-417)
+    bad = [i for i, f in enumerate(fields)
+           if any(int(f.halowidths[d]) < 1 for d in range(min(len(f.A.shape), NDIMS)))]
+    if bad:
+        raise InvalidArgumentError(
+            f"The field(s) at position(s) {[i + 1 for i in bad]} have a halowidth less than 1."
+        )
+
+    # no halo in any dimension (reference :419-431)
+    no_halo = []
+    for i, f in enumerate(fields):
+        loc = local_shape_of(f.A.shape)
+        if all(not has_halo(loc, f.halowidths, d) for d in range(len(loc))):
+            no_halo.append(i)
+    if no_halo:
+        raise IncoherentArgumentError(
+            f"The field(s) at position(s) {[i + 1 for i in no_halo]} have no halo; "
+            "remove them from the call."
+        )
+
+    # duplicates (reference :433-439)
+    dup = [(i, j) for i in range(len(fields)) for j in range(i + 1, len(fields))
+           if fields[i].A is fields[j].A]
+    if dup:
+        i, j = dup[0]
+        raise IncoherentArgumentError(
+            f"The field at position {j + 1} is a duplicate of the one at position {i + 1}; "
+            "remove the duplicate from the call."
+        )
+
+    # supported array type (reference :457-463): anything array-like that jnp accepts
+    unsupported = [i for i, f in enumerate(fields) if not hasattr(f.A, "shape")]
+    if unsupported:
+        raise InvalidArgumentError(
+            f"The field(s) at position(s) {[i + 1 for i in unsupported]} do not have a "
+            "supported array type."
+        )
+
+    # dtype must be a numeric/bool dtype (analog of the isbits check :441-447)
+    for i, f in enumerate(fields):
+        dt = np.dtype(getattr(f.A, "dtype", None) or np.asarray(f.A).dtype)
+        if dt.kind not in "biufc":
+            raise InvalidArgumentError(
+                f"The field at position {i + 1} has unsupported element type {dt}."
+            )

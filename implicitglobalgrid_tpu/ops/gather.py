@@ -1,0 +1,158 @@
+"""Gather a distributed field to the host — analog of reference `gather!`
+(`/root/reference/src/gather.jl:18-54`).
+
+The reference gathers every rank's local array (halo NOT stripped) into one
+big array of shape ``dims .* size(A)`` on the root via an MPI derived-subarray
+Gatherv. Here the stacked global `jax.Array` already IS that concatenation —
+its shards assemble on `device_get` — so the single-controller path is a
+device-to-host transfer, and the multi-host path is a
+`multihost_utils.process_allgather`. Matching the reference's memory
+semantics (`gather.jl:15-16`), only the ``root`` process returns the array.
+
+`gather_interior` additionally strips the overlap duplication and returns the
+true implicit global grid (size ``nxyz_g``) — the reference leaves this to
+user code (e.g. halo-strip before gather, `README.md:147-148`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..parallel.topology import check_initialized, global_grid
+from ..utils.exceptions import IncoherentArgumentError, InvalidArgumentError
+from .fields import local_shape_of
+
+__all__ = ["gather", "gather_interior"]
+
+
+def _to_host(A) -> np.ndarray:
+    import jax
+
+    if not hasattr(A, "shape"):
+        raise InvalidArgumentError("gather expects an array.")
+    if hasattr(A, "is_fully_addressable") and not A.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(A, tiled=True))
+    return np.asarray(jax.device_get(A))
+
+
+def gather(A, A_global=None, *, root: int = 0):
+    """Gather stacked field ``A`` to the host.
+
+    Returns the full stacked array (shape ``dims .* local_shape`` — identical
+    to the reference's ``A_global``) on the ``root`` process, ``None`` on
+    others. If ``A_global`` (a numpy array) is given, the result is written
+    into it in place (reference in-place signature `gather!(A, A_global)`).
+    """
+    import jax
+
+    check_initialized()
+    gg = global_grid()
+    me = jax.process_index()
+
+    # NOTE: _to_host may be a COLLECTIVE in multi-host runs (process_allgather)
+    # — it must run on every process before any root-only validation can
+    # raise, or non-root processes would hang in the collective.
+    host = _to_host(A)
+    if me == root and A_global is not None:
+        loc = local_shape_of(A.shape)
+        expected = tuple(
+            int(gg.dims[d]) * int(loc[d]) if d < 3 else int(loc[d])
+            for d in range(len(loc))
+        )
+        if tuple(int(s) for s in A_global.shape) != expected:
+            raise IncoherentArgumentError(
+                "The size of the global array `size(A_global)` must be equal to the "
+                f"product of `size(A)` and `dims` (expected {expected}, got "
+                f"{tuple(A_global.shape)})."
+            )
+    if me != root:
+        return None
+    if A_global is not None:
+        np.copyto(np.asarray(A_global), host)
+        return A_global
+    return host
+
+
+def gather_interior(A, *, root: int = 0):
+    """Gather ``A`` and strip the overlap duplication, returning the implicit
+    global grid (per-array global size, ``nx_g(A) x ny_g(A) x nz_g(A)`` —
+    reference `tools.jl:45-59`) on ``root``, ``None`` elsewhere.
+
+    Mapping (from the reference's coordinate formula `tools.jl:100`): local
+    cell ``i`` of shard ``c`` is global cell ``c*(n - ol) + i`` (non-periodic;
+    shards overlap by ``ol``, later shards win ties harmlessly — overlapping
+    cells are equal after `update_halo`). Periodic dims shift by one ghost
+    cell and wrap (`tools.jl:102-104`).
+    """
+    import jax
+
+    check_initialized()
+    gg = global_grid()
+    host = _to_host(A)
+    if jax.process_index() != root:
+        return None
+
+    loc = local_shape_of(host.shape)
+    nd = len(loc)
+    out_shape = []
+    for d in range(nd):
+        n = int(loc[d])
+        if d >= 3 or int(gg.dims[d]) == 1 and not gg.periods[d]:
+            dd, ol_d, per = 1, 0, False
+        else:
+            dd = int(gg.dims[d])
+            ol_d = int(gg.overlaps[d] + (n - gg.nxyz[d]))
+            per = bool(gg.periods[d])
+        out_shape.append(dd * (n - ol_d) if per else dd * (n - ol_d) + ol_d)
+
+    out = np.empty(tuple(out_shape), dtype=host.dtype)
+    # Iterate shards; place each local block at its global offset.
+    dims3 = [int(gg.dims[d]) if d < 3 else 1 for d in range(nd)]
+    for cidx in np.ndindex(*dims3):
+        src = [slice(None)] * nd
+        dst = [slice(None)] * nd
+        ok = True
+        for d in range(nd):
+            n = int(loc[d])
+            dd = dims3[d]
+            ol_d = int(gg.overlaps[d] + (n - gg.nxyz[d])) if d < 3 else 0
+            per = bool(gg.periods[d]) if d < 3 else False
+            c = cidx[d]
+            if per:
+                # contribute i in [1, n-ol_d]  → global (c*(n-ol_d)+i-1) mod N
+                start_g = (c * (n - ol_d)) % out_shape[d]
+                src[d] = slice(1, n - ol_d + 1)
+                dst[d] = slice(start_g, start_g + (n - ol_d))
+            else:
+                keep = n if c == dd - 1 else n - ol_d
+                src[d] = slice(0, keep)
+                dst[d] = slice(c * (n - ol_d), c * (n - ol_d) + keep)
+            src_stack = slice(c * n + src[d].start, c * n + src[d].stop)
+            src[d] = src_stack
+            ok = ok and (dst[d].stop <= out_shape[d])
+        if not ok:  # periodic wrap crossing the end: split the copy
+            _copy_wrapped(out, host, src, dst, out_shape)
+        else:
+            out[tuple(dst)] = host[tuple(src)]
+    return out
+
+
+def _copy_wrapped(out, host, src, dst, out_shape):
+    """Copy with modulo wrap along dims whose destination crosses the end."""
+    nd = len(out_shape)
+    # Split recursively on the first wrapping dim.
+    for d in range(nd):
+        if dst[d].stop > out_shape[d]:
+            n1 = out_shape[d] - dst[d].start
+            a_src = list(src); a_dst = list(dst)
+            b_src = list(src); b_dst = list(dst)
+            a_src[d] = slice(src[d].start, src[d].start + n1)
+            a_dst[d] = slice(dst[d].start, out_shape[d])
+            b_src[d] = slice(src[d].start + n1, src[d].stop)
+            b_dst[d] = slice(0, dst[d].stop - out_shape[d])
+            _copy_wrapped(out, host, a_src, a_dst, out_shape)
+            _copy_wrapped(out, host, b_src, b_dst, out_shape)
+            return
+    out[tuple(dst)] = host[tuple(src)]

@@ -1,0 +1,286 @@
+"""Halo exchange — the hot path.
+
+TPU-native re-design of the reference's `update_halo!`
+(`/root/reference/src/update_halo.jl:29-83`). The reference's machinery per
+dimension — pack kernels into send buffers (`update_halo.jl:212-269`,
+`CUDAExt/update_halo.jl:210-227`), nonblocking `MPI.Isend`/`MPI.Irecv!`
+(`update_halo.jl:337-361`), unpack, and a buffer pool (`update_halo.jl:97-201`)
+— collapses on TPU into ONE pair of `lax.ppermute` collectives per (axis,
+direction) inside `shard_map`:
+
+    slice send slab  →  ppermute over the mesh axis (ICI hop)  →
+    dynamic_update_slice into the halo region
+
+XLA fuses the slicing around the collective, owns all buffers, and its
+latency-hiding scheduler overlaps the permutes of independent fields — the
+roles of the reference's pinned staging buffers, max-priority CUDA streams
+(`CUDAExt/update_halo.jl:157`), and multi-field pipelining (`update_halo.jl:17`).
+
+Exchange semantics reproduced exactly (index math from
+`update_halo.jl:275-296`, 0-based here):
+
+- send slab, right side (n=2): ``[s-ol, s-ol+hw)``; left (n=1): ``[ol-hw, ol)``
+- recv slab, right side (n=2): ``[s-hw, s)``;      left (n=1): ``[0, hw)``
+- a field participates along a dim iff ``ol(dim, A) >= 2*hw[dim]``
+  (`update_halo.jl:233`)
+- dimensions are processed strictly sequentially (default order z, x, y —
+  `update_halo.jl:29,45`) so corner/edge values propagate across dims; the
+  data dependence through the updated array enforces this under XLA too.
+- non-periodic boundary shards keep their halo values (the reference's
+  `MPI.PROC_NULL` no-op neighbors, `init_global_grid.jl:103`): masked with a
+  select on the mesh coordinate (`lax.axis_index`).
+- a periodic axis with a single shard short-circuits to local slab copies
+  (the reference's self-neighbor path, `update_halo.jl:62-68,363-380`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+from ..parallel.topology import (
+    AXIS_NAMES, NDIMS, check_initialized, global_grid, grid_epoch,
+)
+from ..utils.exceptions import IncoherentArgumentError, InvalidArgumentError
+from .fields import (
+    Field, check_fields, extract, field_partition_spec, wrap_field,
+)
+
+__all__ = ["update_halo", "local_update_halo", "free_update_halo_caches",
+           "DEFAULT_DIMS_ORDER"]
+
+# Reference default `dims=(3,1,2)` (1-based: z, x, y — update_halo.jl:29).
+DEFAULT_DIMS_ORDER = (2, 0, 1)
+
+# jit-compiled exchange functions keyed by (grid epoch, field signature, dims
+# order). The analog of the reference's persistent buffer pool + task/stream
+# pools (`update_halo.jl:97-201,207`): allocated lazily on first use, reused
+# across calls, freed by `finalize_global_grid`.
+_exchange_cache: dict = {}
+
+
+def free_update_halo_caches() -> None:
+    """Drop compiled exchange programs (analog of
+    `free_update_halo_buffers`, reference `update_halo.jl:103-108`)."""
+    _exchange_cache.clear()
+
+
+def _normalize_dims_order(dims):
+    if dims is None:
+        return DEFAULT_DIMS_ORDER
+    out = tuple(int(d) for d in (dims if np.iterable(dims) else (dims,)))
+    if any(d < 0 or d >= NDIMS for d in out):
+        raise InvalidArgumentError(
+            f"dims must contain 0-based dimension indices in [0, {NDIMS}); got {out}. "
+            "(Note: this API is 0-based; the Julia reference's default (3,1,2) is (2,0,1) here.)"
+        )
+    return out
+
+
+def _dim_meta(gg, dim: int):
+    """Static per-dimension exchange metadata."""
+    D = int(gg.dims[dim])
+    periodic = bool(gg.periods[dim])
+    disp = int(gg.disp)
+    return D, periodic, disp
+
+
+def _exchange_dim_local(a, *, dim, hw, ol_d, D, periodic, disp, axis_name):
+    """Exchange the halos of local block ``a`` along array axis ``dim``.
+
+    Runs inside `shard_map`. All shapes/indices are static; only the mesh
+    coordinate (`axis_index`) is traced.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    s = a.shape[dim]
+    if not (0 <= s - ol_d and ol_d - hw >= 0 and hw <= s):
+        raise IncoherentArgumentError(
+            f"Field of local size {s} along dimension {dim} cannot hold send slabs "
+            f"(overlap {ol_d}, halowidth {hw})."
+        )
+    # Send slabs (reference sendranges, update_halo.jl:275-284).
+    send_r = lax.slice_in_dim(a, s - ol_d, s - ol_d + hw, axis=dim)   # n=2
+    send_l = lax.slice_in_dim(a, ol_d - hw, ol_d, axis=dim)           # n=1
+
+    if D == 1:
+        if not periodic:
+            return a
+        # Self-neighbor: periodic axis with one shard — pure local copies
+        # (reference sendrecv_halo_local, update_halo.jl:363-380).
+        a = lax.dynamic_update_slice_in_dim(a, send_r, 0, axis=dim)       # left halo ← own right slab
+        a = lax.dynamic_update_slice_in_dim(a, send_l, s - hw, axis=dim)  # right halo ← own left slab
+        return a
+
+    if periodic:
+        perm_p = [(i, (i + disp) % D) for i in range(D)]
+        perm_m = [(i, (i - disp) % D) for i in range(D)]
+    else:
+        perm_p = [(i, i + disp) for i in range(D - disp)] if disp < D else []
+        perm_m = [(i, i - disp) for i in range(disp, D)] if disp < D else []
+    if not perm_p and not perm_m:
+        return a
+
+    # Both directions posted before any consumption — the analog of the
+    # reference posting all Irecv!/Isend before waiting (update_halo.jl:51-60);
+    # XLA schedules the two collectives concurrently.
+    recv_l = lax.ppermute(send_r, axis_name, perm_p) if perm_p else None  # from coord-disp
+    recv_r = lax.ppermute(send_l, axis_name, perm_m) if perm_m else None  # from coord+disp
+
+    idx = lax.axis_index(axis_name)
+    if recv_l is not None:
+        if not periodic:
+            cur_l = lax.slice_in_dim(a, 0, hw, axis=dim)
+            recv_l = jnp.where(idx >= disp, recv_l, cur_l)  # PROC_NULL edge: keep halo
+        a = lax.dynamic_update_slice_in_dim(a, recv_l, 0, axis=dim)
+    if recv_r is not None:
+        if not periodic:
+            cur_r = lax.slice_in_dim(a, s - hw, s, axis=dim)
+            recv_r = jnp.where(idx < D - disp, recv_r, cur_r)
+        a = lax.dynamic_update_slice_in_dim(a, recv_r, s - hw, axis=dim)
+    return a
+
+
+def local_update_halo(*fields, dims=None):
+    """Halo-exchange local blocks — use INSIDE `shard_map` over the grid mesh.
+
+    This is the local-view programming model of the reference (user code runs
+    per rank; `update_halo!(A)` in the hot loop, e.g.
+    `examples/diffusion3D_multicpu_novis.jl:47`): call it inside your own
+    `shard_map`-mapped step function on per-shard blocks. Functional: returns
+    the updated array(s).
+
+    Arguments may be arrays or ``Field(A, halowidths)``; ``dims`` is the
+    0-based dimension processing order (default z, x, y like the reference's
+    `(3,1,2)`).
+    """
+    check_initialized()
+    gg = global_grid()
+    dims_order = _normalize_dims_order(dims)
+    fs = [wrap_field(f) for f in fields]
+    arrays = [f.A for f in fs]
+    for dim in dims_order:
+        D, periodic, disp = _dim_meta(gg, dim)
+        if D == 1 and not periodic:
+            continue  # no neighbors along this axis (reference update_halo.jl:45 note)
+        for i, f in enumerate(fs):
+            a = arrays[i]
+            if dim >= a.ndim:
+                continue
+            hw = int(f.halowidths[dim])
+            ol_d = int(gg.overlaps[dim] + (a.shape[dim] - gg.nxyz[dim]))
+            if ol_d < 2 * hw:
+                continue  # computation overlap only, no halo (update_halo.jl:233)
+            arrays[i] = _exchange_dim_local(
+                a, dim=dim, hw=hw, ol_d=ol_d, D=D, periodic=periodic,
+                disp=disp, axis_name=AXIS_NAMES[dim],
+            )
+    return arrays[0] if len(arrays) == 1 else tuple(arrays)
+
+
+def _build_exchange_fn(gg, sig, dims_order):
+    """Compile the jitted shard_map exchange program for a field signature."""
+    import jax
+
+    ndims_arr = [len(shape) for (shape, _, _) in sig]
+    in_specs = tuple(field_partition_spec(nd) for nd in ndims_arr)
+    hws = [hw for (_, _, hw) in sig]
+
+    def exchange(*locals_):
+        arrays = list(locals_)
+        for dim in dims_order:
+            D, periodic, disp = _dim_meta(gg, dim)
+            if D == 1 and not periodic:
+                continue
+            for i, a in enumerate(arrays):
+                if dim >= a.ndim:
+                    continue
+                hw = int(hws[i][dim])
+                ol_d = int(gg.overlaps[dim] + (a.shape[dim] - gg.nxyz[dim]))
+                if ol_d < 2 * hw:
+                    continue
+                arrays[i] = _exchange_dim_local(
+                    a, dim=dim, hw=hw, ol_d=ol_d, D=D, periodic=periodic,
+                    disp=disp, axis_name=AXIS_NAMES[dim],
+                )
+        return tuple(arrays)
+
+    shmapped = jax.shard_map(
+        exchange, mesh=gg.mesh, in_specs=in_specs, out_specs=in_specs
+    )
+    return jax.jit(shmapped)
+
+
+def update_halo(*fields, dims=None):
+    """Update the halo of the given global (stacked) array(s).
+
+    Controller-side API of the reference's `update_halo!`
+    (`/root/reference/src/update_halo.jl:29-36`): arrays are stacked/global
+    `jax.Array`s (shape ``dims * local_shape``, sharded over the grid mesh —
+    each shard is one reference rank-local array). JAX arrays are immutable, so
+    the call is FUNCTIONAL and returns the updated array(s)::
+
+        T = update_halo(T)
+        A, B, C = update_halo(A, B, (C, (2, 2, 2)))   # per-field halowidths
+
+    Fields may be arrays, ``Field(A, halowidths)``, ``(A, halowidths)`` tuples,
+    or pytrees of arrays (the CellArray analog, reference `shared.jl:133-137`).
+    Group several fields in one call for best performance — all their permutes
+    compile into one program and pipeline (reference performance note,
+    `update_halo.jl:17-18`).
+    """
+    import jax.numpy as jnp
+
+    check_initialized()
+    gg = global_grid()
+    dims_order = _normalize_dims_order(dims)
+
+    # Normalize: tuples (A, hw) → Field; pytrees exploded (reference :31-32).
+    fs = []
+    for f in fields:
+        if isinstance(f, tuple) and not isinstance(f, Field) and len(f) == 2 \
+                and hasattr(f[0], "shape") and not hasattr(f[1], "shape"):
+            fs.append(wrap_field(f[0], f[1]))
+        else:
+            fs.extend(wrap_field(x) for x in extract(f))
+    if not fs:
+        raise InvalidArgumentError("update_halo requires at least one field.")
+    for f in fs:
+        if not hasattr(f.A, "shape"):
+            raise InvalidArgumentError("update_halo requires array inputs.")
+        if not (1 <= f.A.ndim <= NDIMS):
+            raise InvalidArgumentError(
+                f"update_halo supports 1-D to {NDIMS}-D arrays; got {f.A.ndim}-D."
+            )
+    check_fields(fs)
+
+    # Validate the stacked layout: every sharded dim must divide evenly.
+    for f in fs:
+        for d in range(f.A.ndim):
+            if int(f.A.shape[d]) % int(gg.dims[d]) != 0:
+                raise IncoherentArgumentError(
+                    f"Global (stacked) array size {f.A.shape[d]} along dimension {d} is not "
+                    f"divisible by dims[{d}]={int(gg.dims[d])}. update_halo operates on "
+                    "stacked global arrays (dims * local size); see local_update_halo for "
+                    "the local view."
+                )
+
+    arrays = [jnp.asarray(f.A) for f in fs]
+    # Signature uses LOCAL shapes: the exchange math runs on per-shard blocks.
+    sig = tuple(
+        (
+            tuple(int(s) // int(gg.dims[d]) for d, s in enumerate(a.shape)),
+            str(a.dtype),
+            tuple(int(h) for h in f.halowidths),
+        )
+        for a, f in zip(arrays, fs)
+    )
+    key = (grid_epoch(), sig, dims_order)
+    fn = _exchange_cache.get(key)
+    if fn is None:
+        fn = _build_exchange_fn(gg, sig, dims_order)
+        _exchange_cache[key] = fn
+    out = fn(*arrays)
+    return out[0] if len(out) == 1 else tuple(out)

@@ -1,0 +1,68 @@
+"""Stencil difference helpers.
+
+jnp analogs of the reference examples' broadcast stencil macros
+(`/root/reference/examples/diffusion3D_multicpu_novis.jl:4-10`):
+``d_xa``/``d_ya``/``d_za`` difference along an axis over the full extent of the
+other axes; ``d_xi``/``d_yi``/``d_zi`` difference along an axis over the INNER
+extent of the other axes; ``inn`` the interior. They work on local blocks (use
+inside `shard_map`) and on stacked arrays alike, for 1-D to 3-D, and XLA fuses
+them into the surrounding arithmetic (no temporaries — the TPU analog of the
+reference's note that broadcast kernels leave >10x headroom vs native kernels,
+`README.md:167`, is simply closed by XLA fusion here).
+"""
+
+from __future__ import annotations
+
+__all__ = ["d_xa", "d_ya", "d_za", "d_xi", "d_yi", "d_zi", "inn"]
+
+
+def _d_a(A, axis: int):
+    from jax import lax
+
+    n = A.shape[axis]
+    return lax.slice_in_dim(A, 1, n, axis=axis) - lax.slice_in_dim(A, 0, n - 1, axis=axis)
+
+
+def _inner_others(A, axis: int):
+    from jax import lax
+
+    for ax in range(A.ndim):
+        if ax != axis:
+            A = lax.slice_in_dim(A, 1, A.shape[ax] - 1, axis=ax)
+    return A
+
+
+def d_xa(A):
+    """``A[2:end,...] - A[1:end-1,...]`` (reference `d_xa`, examples:4)."""
+    return _d_a(A, 0)
+
+
+def d_ya(A):
+    return _d_a(A, 1)
+
+
+def d_za(A):
+    return _d_a(A, 2)
+
+
+def d_xi(A):
+    """Difference along x over the inner extent of the other dims
+    (reference `d_xi`, examples:5)."""
+    return _d_a(_inner_others(A, 0), 0)
+
+
+def d_yi(A):
+    return _d_a(_inner_others(A, 1), 1)
+
+
+def d_zi(A):
+    return _d_a(_inner_others(A, 2), 2)
+
+
+def inn(A):
+    """Interior of ``A`` (reference `inn`, examples:10)."""
+    from jax import lax
+
+    for ax in range(A.ndim):
+        A = lax.slice_in_dim(A, 1, A.shape[ax] - 1, axis=ax)
+    return A

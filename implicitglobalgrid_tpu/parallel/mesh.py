@@ -1,0 +1,74 @@
+"""Device-mesh construction — the TPU analog of `MPI_Cart_create`.
+
+Where the reference creates a Cartesian MPI communicator
+(`/root/reference/src/init_global_grid.jl:100`), the TPU framework creates a
+`jax.sharding.Mesh` with axes ``("gx", "gy", "gz")`` over the pod's devices.
+The reference's ``reorder`` argument (let MPI renumber ranks for locality) maps
+to letting `mesh_utils.create_device_mesh` pick an ICI-contiguous device
+layout; ``reorder=0`` keeps plain device order.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.exceptions import InvalidArgumentError, NotLoadedError
+from .topology import AXIS_NAMES, NDIMS
+
+__all__ = ["build_mesh", "resolve_devices"]
+
+
+def resolve_devices(device_type: str, platform_override: str | None = None):
+    """Return the JAX device list for ``device_type`` ("auto" picks the default
+    backend; "none" forces CPU — the analog of the reference's CPU-only mode,
+    `init_global_grid.jl:78`)."""
+    import jax
+
+    if platform_override:
+        device_type = platform_override
+    if device_type in ("auto", ""):
+        return jax.devices(), jax.default_backend()
+    if device_type == "none":
+        device_type = "cpu"
+    try:
+        devs = jax.devices(device_type)
+    except RuntimeError as e:
+        raise NotLoadedError(
+            f"device_type {device_type!r}: no functional JAX backend for this platform ({e})."
+        ) from e
+    return devs, device_type
+
+
+def build_mesh(dims, devices, reorder: int = 1):
+    """Create the 3-axis mesh from ``devices``.
+
+    - If the grid uses ALL devices and ``reorder`` is set, delegate to
+      `mesh_utils.create_device_mesh` so the mesh layout follows the physical
+      ICI topology (nearest mesh neighbors = nearest ICI neighbors, which is
+      what makes the per-axis `ppermute` halo exchange ride single ICI hops).
+    - Otherwise (a subset of devices, or ``reorder=0``), reshape in plain
+      enumeration order — the analog of `MPI.Cart_create(..., reorder=0)`.
+    """
+    import jax
+
+    dims = tuple(int(d) for d in dims)
+    if len(dims) != NDIMS:
+        raise InvalidArgumentError(f"dims must have {NDIMS} entries.")
+    n = int(np.prod(dims))
+    if n > len(devices):
+        raise InvalidArgumentError(
+            f"Cannot create a {dims[0]}x{dims[1]}x{dims[2]} grid: requires {n} device(s), "
+            f"but only {len(devices)} available."
+        )
+    use = devices[:n]
+    dev_arr = None
+    if reorder and n == len(devices) and n > 1:
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_arr = mesh_utils.create_device_mesh(dims, devices=use)
+        except Exception:
+            dev_arr = None  # fall back to plain order below
+    if dev_arr is None:
+        dev_arr = np.array(use, dtype=object).reshape(dims)
+    return jax.sharding.Mesh(dev_arr, AXIS_NAMES)

@@ -1,0 +1,206 @@
+"""Global-grid queries: sizes, coordinates, timing.
+
+TPU-native re-design of the reference's `src/tools.jl`:
+
+- ``nx_g/ny_g/nz_g`` — implicit global sizes, with per-array overloads for
+  staggered fields (`tools.jl:24-59`).
+- ``x_g/y_g/z_g`` — global coordinate of a local index, including the
+  staggering offset and the periodic ghost-cell shift/wrap
+  (`tools.jl:98-107`; the math is subtle and ported exactly).
+- vectorized coordinate builders (``x_g_vec``/``coords_g``) — the TPU-native
+  way to build initial conditions: instead of per-rank comprehensions
+  (reference `examples/diffusion3D_multigpu_CuArrays_novis.jl:35-38`), build
+  the full stacked coordinate array once and use jnp broadcasts.
+- ``tic/toc`` — wall-clock with a device/process barrier (`tools.jl:230-236`).
+
+Coordinate conventions: indices here are 0-based (Python); the reference is
+1-based Julia. `x_g(ix, ...)` here takes a 0-based local index and returns the
+same coordinate the reference returns for `ix+1`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .parallel.topology import (
+    NDIMS, check_initialized, global_grid,
+)
+from .ops.fields import local_shape_of
+from .utils.exceptions import InvalidArgumentError
+
+__all__ = [
+    "nx_g", "ny_g", "nz_g", "x_g", "y_g", "z_g",
+    "x_g_vec", "y_g_vec", "z_g_vec", "coords_g",
+]
+
+
+def _shape_of(A):
+    if A is None:
+        return None
+    if hasattr(A, "shape"):
+        return tuple(int(s) for s in A.shape)
+    raise InvalidArgumentError(f"Expected an array, got {type(A)}.")
+
+
+def _n_g(dim: int, A=None) -> int:
+    """Global size along ``dim``; with an array, the array's own global size
+    including staggering (reference `tools.jl:45-59`:
+    ``nx_g(A) = nx_g() + (size(A,1) - nx)``)."""
+    gg = global_grid()
+    if A is None:
+        return int(gg.nxyz_g[dim])
+    shape = _shape_of(A)
+    loc = local_shape_of(shape)
+    size_d = loc[dim] if dim < len(loc) else 1
+    return int(gg.nxyz_g[dim]) + (size_d - int(gg.nxyz[dim]))
+
+
+def nx_g(A=None) -> int:
+    """Size of the global grid in dimension x; ``nx_g(A)`` for array ``A``'s
+    global size (staggered arrays differ; reference `tools.jl:24,45`)."""
+    return _n_g(0, A)
+
+
+def ny_g(A=None) -> int:
+    """Size of the global grid in dimension y (reference `tools.jl:31,52`)."""
+    return _n_g(1, A)
+
+
+def nz_g(A=None) -> int:
+    """Size of the global grid in dimension z (reference `tools.jl:38,59`)."""
+    return _n_g(2, A)
+
+
+def _coord_g(i0, dim: int, dcoord, size_d: int, coord):
+    """Global coordinate math (reference `tools.jl:98-107`), for scalar or
+    vector ``i0`` (0-based local index) and scalar or traced ``coord``.
+
+    x0 shifts staggered arrays; the periodic branch shifts everything left by
+    one cell (the first global cell is a ghost cell) and wraps into
+    ``[0, nxyz_g*d)`` (reference `tools.jl:102-104`).
+    """
+    import jax.numpy as jnp
+
+    gg = global_grid()
+    n = int(gg.nxyz[dim])
+    olp = int(gg.overlaps[dim])
+    n_gl = int(gg.nxyz_g[dim])
+    x0 = 0.5 * (n - size_d) * dcoord
+    x = (coord * (n - olp) + i0) * dcoord + x0
+    if bool(gg.periods[dim]):
+        x = x - dcoord
+        if np.isscalar(x) or isinstance(x, (int, float, np.generic)):
+            if x > (n_gl - 1) * dcoord:
+                x = x - n_gl * dcoord
+            if x < 0:
+                x = x + n_gl * dcoord
+        else:
+            x = jnp.where(x > (n_gl - 1) * dcoord, x - n_gl * dcoord, x)
+            x = jnp.where(x < 0, x + n_gl * dcoord, x)
+    return x
+
+
+def _x_g(ix, dcoord, A, dim: int, coords=None):
+    """Scalar/per-index global coordinate for local index ``ix`` (0-based) of
+    array ``A`` along ``dim``.
+
+    - For a stacked/global array, ``ix`` is the stacked index: the shard
+      coordinate and local index are derived statically.
+    - For a local block: pass ``coords`` (shard coordinate, scalar or the
+      full 3-tuple) explicitly, or call inside `shard_map` where the mesh
+      coordinate is taken from `lax.axis_index` (the analog of the reference
+      reading the rank's `coords`, `tools.jl:100`).
+    """
+    check_initialized()
+    gg = global_grid()
+    shape = _shape_of(A)
+    loc = local_shape_of(shape)
+    size_d = loc[dim] if dim < len(loc) else 1
+    shape_d = shape[dim] if dim < len(shape) else 1
+    stacked = shape_d != size_d or int(gg.dims[dim]) == 1
+
+    if stacked and coords is None:
+        coord, i_local = divmod(int(ix), size_d)
+        return _coord_g(i_local, dim, dcoord, size_d, coord)
+
+    if coords is not None:
+        coord = coords[dim] if np.iterable(coords) else coords
+        return _coord_g(ix, dim, dcoord, size_d, int(coord))
+
+    # Local block, no explicit coords: use the traced mesh coordinate.
+    from jax import lax
+    from .parallel.topology import AXIS_NAMES
+
+    try:
+        coord = lax.axis_index(AXIS_NAMES[dim])
+    except NameError as e:
+        raise InvalidArgumentError(
+            "x_g/y_g/z_g on a local block outside shard_map requires the shard "
+            "coordinate: pass coords=<mesh coordinate(s)>."
+        ) from e
+    return _coord_g(ix, dim, dcoord, size_d, coord)
+
+
+def x_g(ix, dx, A, coords=None):
+    """Global x-coordinate of 0-based local index ``ix`` in array ``A``
+    (reference `tools.jl:98-107`)."""
+    return _x_g(ix, dx, A, 0, coords)
+
+
+def y_g(iy, dy, A, coords=None):
+    """Global y-coordinate (reference `tools.jl:146-155`)."""
+    return _x_g(iy, dy, A, 1, coords)
+
+
+def z_g(iz, dz, A, coords=None):
+    """Global z-coordinate (reference `tools.jl:194-203`)."""
+    return _x_g(iz, dz, A, 2, coords)
+
+
+def _x_g_vec(dcoord, A, dim: int):
+    """Stacked 1-D coordinate vector along ``dim`` for array/shape ``A``:
+    entry ``i`` is the global coordinate of stacked index ``i``. Host-computed
+    numpy (init-time only)."""
+    check_initialized()
+    shape = _shape_of(A) if hasattr(A, "shape") else tuple(A)
+    loc = local_shape_of(shape)
+    gg = global_grid()
+    size_d = loc[dim] if dim < len(loc) else 1
+    n_stack = int(gg.dims[dim]) * size_d if dim < NDIMS else size_d
+    idx = np.arange(n_stack)
+    coord, i_local = idx // size_d, idx % size_d
+    return _coord_g(i_local.astype(np.float64), dim, dcoord, size_d, coord.astype(np.float64))
+
+
+def x_g_vec(dx, A):
+    """Vector of global x-coordinates for every stacked index of ``A``."""
+    return _x_g_vec(dx, A, 0)
+
+
+def y_g_vec(dy, A):
+    return _x_g_vec(dy, A, 1)
+
+
+def z_g_vec(dz, A):
+    return _x_g_vec(dz, A, 2)
+
+
+def coords_g(dx, dy, dz, A):
+    """Broadcastable (x, y, z) global-coordinate arrays for stacked array ``A``
+    — the TPU-native initial-condition idiom::
+
+        x, y, z = coords_g(dx, dy, dz, T)            # shapes (nx,1,1),(1,ny,1),(1,1,nz)
+        T = 100 * jnp.exp(-((x-lx/2)/2)**2 - ((y-ly/2)/2)**2 - ((z-lz/3)/2)**2)
+
+    replacing the reference's per-rank comprehension IC pattern
+    (`examples/diffusion3D_multigpu_CuArrays_novis.jl:35-38`).
+    """
+    shape = _shape_of(A) if hasattr(A, "shape") else tuple(A)
+    nd = len(shape)
+    outs = []
+    for dim, d in zip(range(min(nd, NDIMS)), (dx, dy, dz)):
+        v = np.asarray(_x_g_vec(d, shape, dim))
+        sh = [1] * nd
+        sh[dim] = v.shape[0]
+        outs.append(v.reshape(sh))
+    return tuple(outs)

@@ -1,0 +1,60 @@
+"""Typed exceptions for the TPU implicit-global-grid framework.
+
+TPU-native re-design of the reference's exception module
+(`/root/reference/src/Exceptions.jl:1-49`): the reference defines seven typed
+exception structs plus throw-macros. Here they are plain Python exception
+classes; unlike the reference (which mostly raises untyped `error()` despite
+defining these), this framework raises the typed classes everywhere so callers
+and tests can catch precisely.
+"""
+
+__all__ = [
+    "GlobalGridError",
+    "ModuleInternalError",
+    "NotInitializedError",
+    "AlreadyInitializedError",
+    "InvalidArgumentError",
+    "IncoherentArgumentError",
+    "KeywordArgumentError",
+    "NotLoadedError",
+    "NotSupportedError",
+]
+
+
+class GlobalGridError(Exception):
+    """Base class for all framework errors."""
+
+
+class ModuleInternalError(GlobalGridError):
+    """An internal invariant was violated (reference: `Exceptions.jl` ModuleInternalError)."""
+
+
+class NotInitializedError(GlobalGridError):
+    """API used before `init_global_grid` / after `finalize_global_grid`
+    (reference: `shared.jl:90` check_initialized)."""
+
+
+class AlreadyInitializedError(GlobalGridError):
+    """`init_global_grid` called twice (reference: `init_global_grid.jl:42`)."""
+
+
+class InvalidArgumentError(GlobalGridError):
+    """An argument value is invalid on its own (reference: `Exceptions.jl` InvalidArgumentError)."""
+
+
+class IncoherentArgumentError(GlobalGridError):
+    """Arguments are individually valid but mutually incoherent
+    (reference: `Exceptions.jl` IncoherentArgumentError)."""
+
+
+class KeywordArgumentError(GlobalGridError):
+    """A keyword argument is not supported in this context."""
+
+
+class NotLoadedError(GlobalGridError):
+    """A required backend/extension is not available
+    (reference: `Exceptions.jl` NotLoadedError)."""
+
+
+class NotSupportedError(GlobalGridError):
+    """Feature unsupported for the given input (reference: `shared.jl:176` B>1 CellArrays)."""

@@ -1,0 +1,80 @@
+"""Barrier-synchronized timing — analog of reference `tic`/`toc`
+(`/root/reference/src/tools.jl:230-236`): `MPI.Barrier(comm())` + wall clock.
+
+On TPU the barrier is: flush every device's execution queue by running a tiny
+jitted psum over the full grid mesh and blocking on the result (devices
+execute their queues in order, so the probe completing means all previously
+enqueued work completed), plus a cross-process sync in multi-host deployments
+(`multihost_utils.sync_global_devices`). The probe is compiled once at init
+(analog of the reference pre-compiling tic/toc, `init_global_grid.jl:119-123`).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..parallel.topology import AXIS_NAMES, check_initialized, global_grid
+
+__all__ = ["tic", "toc", "barrier", "init_timing_functions"]
+
+_t0 = None
+_probe_cache: dict = {}
+
+
+def _device_barrier() -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    gg = global_grid()
+    mesh = gg.mesh
+    if mesh is None:
+        return
+    key = gg.epoch
+    fn = _probe_cache.get(key)
+    if fn is None:
+        _probe_cache.clear()
+
+        def probe(x):
+            s = x
+            for ax in AXIS_NAMES:
+                s = jax.lax.psum(s, ax)
+            return s
+
+        fn = jax.jit(jax.shard_map(probe, mesh=mesh, in_specs=P(), out_specs=P()))
+        _probe_cache[key] = fn
+    jax.block_until_ready(fn(jnp.zeros(())))
+    if jax.process_count() > 1:  # DCN barrier for multi-host
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("igg_tpu_barrier")
+
+
+def barrier() -> None:
+    """Block until all devices (and processes) reach this point."""
+    check_initialized()
+    _device_barrier()
+
+
+def tic() -> None:
+    """Start the chronometer once all devices have reached this point
+    (reference `tools.jl:234`)."""
+    global _t0
+    check_initialized()
+    _device_barrier()
+    _t0 = time.time()
+
+
+def toc() -> float:
+    """Elapsed seconds since `tic` once all devices have reached this point
+    (reference `tools.jl:235`)."""
+    check_initialized()
+    _device_barrier()
+    return time.time() - _t0
+
+
+def init_timing_functions() -> None:
+    """Pre-compile the barrier probe so first user timing is cheap
+    (reference `init_global_grid.jl:119-123`)."""
+    tic()
+    toc()

@@ -1,0 +1,39 @@
+"""Test harness configuration.
+
+TPU analog of the reference's test strategy (`SURVEY.md` §4,
+`/root/reference/test/runtests.jl`): nearly all functionality is verified on
+one HOST by emulating a multi-device mesh — 8 virtual CPU devices via
+``--xla_force_host_platform_device_count`` (the analog of the reference's
+"1 process + periodic self-neighbors" and `mpirun -np N` techniques,
+`test/test_update_halo.jl:1-3`).
+
+Must configure JAX before any backend initialization: set the flags at import
+time, before any test module imports jax.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)  # reference default dtype is Float64
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_grid():
+    """Ensure no grid state leaks between tests (each reference test file
+    re-inits/finalizes repeatedly with `init_MPI=false` — same hygiene here)."""
+    import implicitglobalgrid_tpu as igg
+
+    if igg.grid_is_initialized():
+        igg.finalize_global_grid()
+    yield
+    if igg.grid_is_initialized():
+        igg.finalize_global_grid()

@@ -1,0 +1,73 @@
+"""Tests of `gather`/`gather_interior` — port of `test/test_gather.jl` ideas:
+assembly of the stacked global array (reference `gather!` semantics: halo NOT
+stripped, global size = dims .* local size, `gather.jl:33`), the in-place
+`A_global` form, size-mismatch errors, plus the interior (implicit-grid)
+assembly that the reference leaves to user code (`README.md:147-148`)."""
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.utils.exceptions import IncoherentArgumentError
+
+
+def _encoded():
+    A = igg.zeros_g()
+    cs = igg.coords_g(1.0, 1.0, 1.0, A)
+    enc = sum(np.asarray(c) * 10.0 ** (3 * d) for d, c in enumerate(cs))
+    return igg.device_put_g(np.ascontiguousarray(enc + np.zeros(A.shape)))
+
+
+def test_gather_stacked():
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, quiet=True)
+    P = _encoded()
+    G = igg.gather(P)
+    assert isinstance(G, np.ndarray) and G.shape == (10, 10, 10)
+    assert np.array_equal(G, np.asarray(P))
+
+
+def test_gather_in_place_and_size_check():
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, quiet=True)
+    P = _encoded()
+    out = np.zeros((10, 10, 10))
+    ret = igg.gather(P, out)
+    assert ret is out and np.array_equal(out, np.asarray(P))
+    with pytest.raises(IncoherentArgumentError):
+        igg.gather(P, np.zeros((9, 10, 10)))
+
+
+def test_gather_2d():
+    igg.init_global_grid(6, 6, 1, dimx=4, dimy=2, quiet=True)
+    A = igg.zeros_g((6, 6)) + 3.0
+    G = igg.gather(A)
+    assert G.shape == (24, 12) and np.all(G == 3.0)
+
+
+def test_gather_interior_nonperiodic():
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, quiet=True)
+    P = igg.update_halo(_encoded())
+    GI = igg.gather_interior(P)
+    assert GI.shape == (igg.nx_g(), igg.ny_g(), igg.nz_g()) == (8, 8, 8)
+    # interior values are exactly the coordinate encoding of the implicit grid
+    idx = np.arange(8)
+    exp = (idx[:, None, None] + 1e3 * idx[None, :, None] + 1e6 * idx[None, None, :])
+    assert np.array_equal(GI, exp)
+
+
+def test_gather_interior_periodic():
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    P = igg.update_halo(_encoded())
+    GI = igg.gather_interior(P)
+    assert GI.shape == (6, 6, 6)
+    idx = np.arange(6)
+    exp = (idx[:, None, None] + 1e3 * idx[None, :, None] + 1e6 * idx[None, None, :])
+    assert np.array_equal(GI, exp)
+
+
+def test_gather_interior_staggered():
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, quiet=True)
+    Vx = igg.zeros_g((6, 5, 5)) + 7.0
+    GI = igg.gather_interior(Vx)
+    assert GI.shape == (igg.nx_g(Vx), igg.ny_g(), igg.nz_g()) == (9, 8, 8)
+    assert np.all(GI == 7.0)

@@ -1,0 +1,120 @@
+"""Tests of the query layer — port of `test/test_tools.jl` ideas: global
+sizes incl. staggered-array overloads (`test_tools.jl` / reference
+`tools.jl:24-59`), and the x_g/y_g/z_g coordinate math with staggering and
+periodic wrap, swept over simulated shard coordinates (the reference's
+simulated-topology technique, `test_tools.jl:116-163`)."""
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+
+
+def test_nx_g_plain_and_staggered():
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, quiet=True)
+    assert (igg.nx_g(), igg.ny_g(), igg.nz_g()) == (8, 8, 8)
+    A = np.zeros((5, 5, 5))
+    Vx = np.zeros((6, 5, 5))
+    Vy = np.zeros((5, 6, 5))
+    Vz = np.zeros((5, 5, 6))
+    assert igg.nx_g(A) == 8 and igg.nx_g(Vx) == 9
+    assert igg.ny_g(Vy) == 9 and igg.ny_g(Vx) == 8
+    assert igg.nz_g(Vz) == 9
+    # stacked-global arrays give the same answers
+    assert igg.nx_g(igg.zeros_g()) == 8
+    assert igg.nx_g(igg.zeros_g((6, 5, 5))) == 9
+
+
+def test_x_g_doctest_values():
+    # reference doctest (tools.jl:67-96): lx=4, nx=ny=nz=3, 1 "process"
+    igg.init_global_grid(3, 3, 3, dimx=1, dimy=1, dimz=1, quiet=True)
+    dx = 4 / (igg.nx_g() - 1)
+    assert dx == 2.0
+    A = np.zeros((3, 3, 3))
+    Vx = np.zeros((4, 3, 3))
+    assert [igg.x_g(i, dx, A) for i in range(3)] == [0.0, 2.0, 4.0]
+    assert [igg.x_g(i, dx, Vx) for i in range(4)] == [-1.0, 1.0, 3.0, 5.0]
+    assert [igg.y_g(i, dx, np.zeros((3, 4, 3))) for i in range(4)] == [-1.0, 1.0, 3.0, 5.0]
+    assert [igg.z_g(i, dx, np.zeros((3, 3, 4))) for i in range(4)] == [-1.0, 1.0, 3.0, 5.0]
+
+
+def test_x_g_multi_shard_coverage():
+    # dims=(3,1,1), nx=4, ol=2: nxyz_g = 3*2+2 = 8; block c covers (c*2 .. c*2+3)
+    igg.init_global_grid(4, 3, 3, dimx=3, dimy=1, dimz=1, quiet=True)
+    assert igg.nx_g() == 8
+    A = np.zeros((4, 3, 3))
+    for c in range(3):
+        xs = [igg.x_g(i, 1.0, A, coords=c) for i in range(4)]
+        assert xs == [c * 2 + i for i in range(4)]
+
+
+def test_x_g_periodic_wrap():
+    # periodic: ghost-cell shift by -dx then wrap into [0, nx_g*dx) (tools.jl:102-104)
+    igg.init_global_grid(4, 3, 3, dimx=3, dimy=1, dimz=1, periodx=1, quiet=True)
+    assert igg.nx_g() == 6
+    A = np.zeros((4, 3, 3))
+    assert [igg.x_g(i, 1.0, A, coords=0) for i in range(4)] == [5.0, 0.0, 1.0, 2.0]
+    assert [igg.x_g(i, 1.0, A, coords=2) for i in range(4)] == [3.0, 4.0, 5.0, 0.0]
+    # every global cell covered exactly once by the interior cells
+    cover = sorted(
+        igg.x_g(i, 1.0, A, coords=c) for c in range(3) for i in range(1, 3)
+    )
+    assert cover == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+def test_x_g_stacked_equals_local():
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, quiet=True)
+    T = igg.zeros_g()
+    A = np.zeros((5, 5, 5))
+    for c in range(2):
+        for i in range(5):
+            assert igg.x_g(c * 5 + i, 0.5, T) == igg.x_g(i, 0.5, A, coords=c)
+            assert igg.y_g(c * 5 + i, 0.5, T) == igg.y_g(i, 0.5, A, coords=c)
+
+
+def test_coords_g_broadcastable():
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, quiet=True)
+    T = igg.zeros_g()
+    x, y, z = igg.coords_g(1.0, 1.0, 1.0, T)
+    assert x.shape == (10, 1, 1) and y.shape == (1, 10, 1) and z.shape == (1, 1, 10)
+    assert float(x[5, 0, 0]) == igg.x_g(5, 1.0, T)
+    # staggered
+    Vx = igg.zeros_g((6, 5, 5))
+    xs, _, _ = igg.coords_g(1.0, 1.0, 1.0, Vx)
+    assert xs.shape == (12, 1, 1)
+    assert float(xs[0, 0, 0]) == igg.x_g(0, 1.0, Vx)
+
+
+def test_x_g_vec_matches_scalar():
+    igg.init_global_grid(4, 4, 4, dimx=2, dimy=2, dimz=2, periody=1, quiet=True)
+    T = igg.zeros_g()
+    xv = np.asarray(igg.x_g_vec(0.25, T))
+    yv = np.asarray(igg.y_g_vec(0.25, T))
+    for i in range(8):
+        assert xv[i] == igg.x_g(i, 0.25, T)
+        assert yv[i] == igg.y_g(i, 0.25, T)
+
+
+def test_simulated_topology_mutation():
+    # the reference mutates the (intentionally mutable) grid vectors to fake
+    # topologies (shared.jl:57 comment; test_tools.jl:116-134) — same here.
+    igg.init_global_grid(4, 4, 4, dimx=1, dimy=1, dimz=1, quiet=True)
+    gg = igg.global_grid()
+    gg.dims[:] = [3, 3, 3]
+    gg.nxyz_g[:] = gg.dims * (gg.nxyz - gg.overlaps) + gg.overlaps * (gg.periods == 0)
+    assert igg.nx_g() == 3 * 2 + 2
+    A = np.zeros((4, 4, 4))
+    # sweep all simulated coordinates: consistent overlap between neighbors
+    for c in range(2):
+        right_edge = [igg.x_g(i, 1.0, A, coords=c) for i in (2, 3)]
+        left_edge = [igg.x_g(i, 1.0, A, coords=c + 1) for i in (0, 1)]
+        assert right_edge == left_edge
+
+
+def test_tic_toc():
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    igg.tic()
+    t = igg.toc()
+    assert t >= 0.0
+    with pytest.raises(Exception):
+        igg.finalize_global_grid(); igg.tic()

@@ -1,0 +1,79 @@
+"""Tests of the topology math: dims_create (MPI_Dims_create analog),
+Cartesian ranks/coords, neighbor tables with PROC_NULL/periodic wrap
+(reference `init_global_grid.jl:98-106`)."""
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.parallel.topology import (
+    PROC_NULL, cart_coords, cart_rank, cart_shift, dims_create, neighbors_table,
+)
+from implicitglobalgrid_tpu.utils.exceptions import IncoherentArgumentError
+
+
+def test_dims_create_balanced():
+    assert list(dims_create(8, (0, 0, 0))) == [2, 2, 2]
+    assert list(dims_create(12, (0, 0, 0))) == [3, 2, 2]
+    assert list(dims_create(6, (0, 0, 0))) == [3, 2, 1] or \
+           list(dims_create(6, (0, 0, 0))) == [2, 3, 1]  # non-increasing preferred
+    assert list(dims_create(7, (0, 0, 0))) == [7, 1, 1]
+    assert list(dims_create(1, (0, 0, 0))) == [1, 1, 1]
+
+
+def test_dims_create_fixed_entries():
+    assert list(dims_create(8, (2, 0, 0))) == [2, 2, 2]
+    assert list(dims_create(8, (4, 0, 0))) == [4, 2, 1]
+    assert list(dims_create(8, (0, 8, 0))) == [1, 8, 1]
+    with pytest.raises(IncoherentArgumentError):
+        dims_create(8, (3, 0, 0))  # 8 not divisible by 3
+    with pytest.raises(IncoherentArgumentError):
+        dims_create(8, (2, 2, 3))  # fully fixed but prod != nprocs
+
+
+def test_dims_create_non_increasing():
+    d = dims_create(24, (0, 0, 0))
+    assert int(np.prod(d)) == 24
+    assert list(d) == sorted(d, reverse=True)  # MPI spec: non-increasing
+
+
+def test_cart_rank_roundtrip():
+    dims = (3, 4, 5)
+    for r in range(3 * 4 * 5):
+        assert cart_rank(cart_coords(r, dims), dims) == r
+
+
+def test_cart_shift_interior_and_edges():
+    dims, periods = (3, 1, 1), (0, 0, 0)
+    left, right = cart_shift((1, 0, 0), 0, 1, dims, periods)
+    assert left == cart_rank((0, 0, 0), dims) and right == cart_rank((2, 0, 0), dims)
+    left, right = cart_shift((0, 0, 0), 0, 1, dims, periods)
+    assert left == PROC_NULL
+    left, right = cart_shift((2, 0, 0), 0, 1, dims, periods)
+    assert right == PROC_NULL
+
+
+def test_cart_shift_periodic_wrap():
+    dims, periods = (3, 1, 1), (1, 0, 0)
+    left, right = cart_shift((0, 0, 0), 0, 1, dims, periods)
+    assert left == cart_rank((2, 0, 0), dims) and right == cart_rank((1, 0, 0), dims)
+    # self-neighbor: periodic with a single shard (reference update_halo.jl:62)
+    left, right = cart_shift((0, 0, 0), 1, 1, (3, 1, 1), (0, 1, 0))
+    assert left == right == cart_rank((0, 0, 0), (3, 1, 1))
+
+
+def test_neighbors_table_against_grid():
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, periodz=1, quiet=True)
+    tbl = neighbors_table((0, 0, 0))
+    assert tbl[0, 0] == PROC_NULL          # no left-x neighbor at coord 0
+    assert tbl[1, 0] == cart_rank((1, 0, 0), (2, 2, 2))
+    assert tbl[0, 2] == cart_rank((0, 0, 1), (2, 2, 2))  # periodic z wraps
+    assert tbl.shape == (2, 3)
+
+
+def test_ol_staggered():
+    # ol(dim, A) = overlaps[dim] + (size(A,dim) - nxyz[dim])  (shared.jl:107)
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, quiet=True)
+    assert igg.ol(0) == 2
+    assert igg.ol(0, (6, 5, 5)) == 3
+    assert igg.ol(1, (5, 4, 5)) == 1
