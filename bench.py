@@ -53,16 +53,22 @@ def main() -> None:
     chunk = max(1, nt // 4)
     run = make_run(p, nt_chunk=chunk)
 
-    # warmup/compile
-    jax.block_until_ready(run(T, Cp))
+    # warmup/compile (sync via a data-dependent scalar fetch: on the axon
+    # tunnel, block_until_ready can return before execution finishes)
+    import jax.numpy as jnp
+
+    def sync(x):
+        return float(jnp.sum(x))
+
+    sync(run(T, Cp)[0])
 
     igg.tic()
     Tc = T
     steps = 0
     while steps < nt:
-        Tc = run(Tc, Cp)
+        Tc, _ = run(Tc, Cp)
         steps += chunk
-    jax.block_until_ready(Tc)
+    sync(Tc)
     t = igg.toc()
 
     cells = float(igg.nx_g()) * float(igg.ny_g()) * float(igg.nz_g())

@@ -39,6 +39,7 @@ from .parallel.topology import (
     neighbors_table, ol, dims_create,
 )
 from .ops.halo import update_halo, local_update_halo, DEFAULT_DIMS_ORDER
+from .ops.overlap import hide_communication
 from .ops.gather import gather, gather_interior
 from .ops.alloc import zeros_g, ones_g, full_g, device_put_g, sharding_of
 from .ops.fields import Field, wrap_field, extract, local_shape_of, stacked_shape
@@ -56,7 +57,7 @@ __all__ = [
     "init_global_grid", "finalize_global_grid", "update_halo", "gather",
     "select_device", "nx_g", "ny_g", "nz_g", "x_g", "y_g", "z_g", "tic", "toc",
     # TPU-native extensions
-    "local_update_halo", "gather_interior", "barrier",
+    "local_update_halo", "hide_communication", "gather_interior", "barrier",
     "zeros_g", "ones_g", "full_g", "device_put_g", "sharding_of",
     "Field", "wrap_field", "extract", "local_shape_of", "stacked_shape",
     "x_g_vec", "y_g_vec", "z_g_vec", "coords_g",

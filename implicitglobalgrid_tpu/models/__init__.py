@@ -5,8 +5,20 @@ from .diffusion import (
     DiffusionParams, init_diffusion3d, init_diffusion2d,
     diffusion_step_local, make_step, make_run, run_diffusion,
 )
+from .acoustic import (
+    AcousticParams, init_acoustic3d, acoustic_step_local,
+    make_acoustic_run, run_acoustic,
+)
+from .stokes import (
+    StokesParams, init_stokes3d, stokes_step_local,
+    make_stokes_run, run_stokes, stokes_residuals,
+)
 
 __all__ = [
     "DiffusionParams", "init_diffusion3d", "init_diffusion2d",
     "diffusion_step_local", "make_step", "make_run", "run_diffusion",
+    "AcousticParams", "init_acoustic3d", "acoustic_step_local",
+    "make_acoustic_run", "run_acoustic",
+    "StokesParams", "init_stokes3d", "stokes_step_local",
+    "make_stokes_run", "run_stokes", "stokes_residuals",
 ]

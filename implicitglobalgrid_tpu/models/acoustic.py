@@ -1,0 +1,112 @@
+"""3-D acoustic wave propagation on a staggered grid.
+
+The BASELINE config "3-D acoustic wave w/ @hide_communication overlap"
+(`/root/repo/BASELINE.json`): first-order velocity–pressure formulation on a
+staggered grid (the classic ParallelStencil miniapp family the reference
+ecosystem benchmarks; the reference provides the staggered-field machinery
+it runs on — per-field overlaps `shared.jl:107`, staggered coordinates
+`tools.jl:98-107`):
+
+    ∂V/∂t = -∇P / ρ          (velocities on cell faces: Vx is (nx+1, ny, nz))
+    ∂P/∂t = -K ∇·V           (pressure at cell centers)
+
+Each step exchanges halos of all four fields; with ``overlap=True`` the
+pressure update runs through `hide_communication` so the P-halo ppermutes
+hide behind interior compute.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..ops.alloc import device_put_g, zeros_g
+from ..ops.halo import local_update_halo
+from ..ops.overlap import hide_communication
+from ..parallel.topology import check_initialized, global_grid
+from ..tools import coords_g, nx_g, ny_g, nz_g
+from .common import make_state_runner, run_chunked
+
+__all__ = ["AcousticParams", "init_acoustic3d", "acoustic_step_local",
+           "make_acoustic_run", "run_acoustic"]
+
+
+@dataclass(frozen=True)
+class AcousticParams:
+    rho: float      # density
+    K: float        # bulk modulus
+    dt: float
+    dx: float
+    dy: float
+    dz: float
+    overlap: bool = False   # hide_communication for the P update
+
+
+def init_acoustic3d(*, rho=1.0, K=1.0, lx=10.0, ly=10.0, lz=10.0,
+                    dtype=None, overlap=False):
+    """State (P, Vx, Vy, Vz) with a Gaussian pressure pulse in the center.
+    Velocities live on faces: Vx is local ``(nx+1, ny, nz)`` (staggered —
+    exercised exactly like the reference's `Vx = zeros(nx+1, ...)` pattern,
+    `tools.jl:88`)."""
+    import jax.numpy as jnp
+
+    check_initialized()
+    gg = global_grid()
+    nx, ny, nz = (int(n) for n in gg.nxyz)
+    dx, dy, dz = lx / (nx_g() - 1), ly / (ny_g() - 1), lz / (nz_g() - 1)
+    c = float(np.sqrt(K / rho))
+    dt = min(dx, dy, dz) / c / np.sqrt(3.1)
+
+    Pz = zeros_g((nx, ny, nz), dtype=dtype)
+    x, y, z = coords_g(dx, dy, dz, Pz)
+    r2 = ((np.asarray(x) - lx / 2) ** 2 + (np.asarray(y) - ly / 2) ** 2
+          + (np.asarray(z) - lz / 2) ** 2)
+    P = device_put_g(np.broadcast_to(np.exp(-r2), Pz.shape).astype(Pz.dtype))
+    Vx = zeros_g((nx + 1, ny, nz), dtype=dtype)
+    Vy = zeros_g((nx, ny + 1, nz), dtype=dtype)
+    Vz = zeros_g((nx, ny, nz + 1), dtype=dtype)
+    return (P, Vx, Vy, Vz), AcousticParams(
+        rho=rho, K=K, dt=dt, dx=dx, dy=dy, dz=dz, overlap=overlap)
+
+
+def acoustic_step_local(state, p: AcousticParams):
+    """One leapfrog step on LOCAL blocks (inside shard_map)."""
+    from jax import lax
+
+    P, Vx, Vy, Vz = state
+
+    # velocity update on interior faces: face i sits between cells i-1, i
+    def dP(A, d):
+        n = A.shape[d]
+        return lax.slice_in_dim(A, 1, n, axis=d) - lax.slice_in_dim(A, 0, n - 1, axis=d)
+
+    Vx = Vx.at[1:-1, :, :].add(-p.dt / p.rho * dP(P, 0) / p.dx)
+    Vy = Vy.at[:, 1:-1, :].add(-p.dt / p.rho * dP(P, 1) / p.dy)
+    Vz = Vz.at[:, :, 1:-1].add(-p.dt / p.rho * dP(P, 2) / p.dz)
+    Vx, Vy, Vz = local_update_halo(Vx, Vy, Vz)
+
+    def p_update(Pc, vx, vy, vz):
+        divV = (dP(vx, 0) / p.dx + dP(vy, 1) / p.dy + dP(vz, 2) / p.dz)
+        return Pc - p.dt * p.K * divV
+
+    if p.overlap:
+        # radius-0 update from face-staggered fields: the shell of P computes
+        # first, its halo ppermutes overlap the interior divergence compute
+        # (hide_communication handles the staggered aux slicing).
+        P = hide_communication(p_update, P, Vx, Vy, Vz, radius=0)
+    else:
+        P = p_update(P, Vx, Vy, Vz)
+        P = local_update_halo(P)
+    return (P, Vx, Vy, Vz)
+
+
+def make_acoustic_run(p: AcousticParams, nt_chunk: int):
+    return make_state_runner(
+        lambda s: acoustic_step_local(s, p), (3, 3, 3, 3),
+        nt_chunk=nt_chunk, key=("acoustic3d", p),
+    )
+
+
+def run_acoustic(state, p: AcousticParams, nt: int, *, nt_chunk: int = 100):
+    return run_chunked(lambda c: make_acoustic_run(p, c), state, nt, nt_chunk)

@@ -1,0 +1,69 @@
+"""Shared model machinery: whole-loop-jitted runners over pytree state.
+
+Every model follows the same TPU-first shape: a pure LOCAL step function over
+per-shard blocks (the reference's per-rank hot loop, e.g.
+`/root/reference/examples/diffusion3D_multicpu_novis.jl:41-48`), compiled as
+ONE XLA program per chunk of time steps (`lax.fori_loop` with the halo
+ppermutes inline) instead of per-step dispatches.
+"""
+
+from __future__ import annotations
+
+from ..ops.fields import field_partition_spec
+from ..parallel.topology import check_initialized, global_grid
+
+__all__ = ["make_state_runner", "run_chunked"]
+
+_runner_cache: dict = {}
+
+
+def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
+                      check_vma: bool = True):
+    """Compile ``state -> state`` advancing ``nt_chunk`` steps.
+
+    ``step_local(state) -> state`` operates on a tuple of LOCAL blocks;
+    ``state_ndims`` gives each block's ndim (its sharding spec). ``key``
+    (hashable) identifies the step function for caching — required because
+    closures are rebuilt per call; pass e.g. (model_name, params, nt_chunk).
+    """
+    import jax
+    from jax import lax
+
+    check_initialized()
+    gg = global_grid()
+    if key is not None:
+        full_key = (gg.epoch, key, tuple(state_ndims), int(nt_chunk))
+        fn = _runner_cache.get(full_key)
+        if fn is not None:
+            return fn
+        if _runner_cache and next(iter(_runner_cache))[0] != gg.epoch:
+            _runner_cache.clear()
+    specs = tuple(field_partition_spec(nd) for nd in state_ndims)
+
+    def chunk(*state):
+        out = lax.fori_loop(0, nt_chunk, lambda i, s: tuple(step_local(s)),
+                            tuple(state))
+        return out
+
+    fn = jax.jit(jax.shard_map(
+        chunk, mesh=gg.mesh, in_specs=specs, out_specs=specs,
+        check_vma=check_vma,
+    ))
+    if key is not None:
+        _runner_cache[full_key] = fn
+    return fn
+
+
+def run_chunked(runner_factory, state, nt: int, nt_chunk: int):
+    """Advance ``nt`` steps using ``runner_factory(chunk_size)``; compiles at
+    most two chunk sizes."""
+    import jax
+
+    full, rem = divmod(nt, nt_chunk)
+    if full:
+        run = runner_factory(nt_chunk)
+        for _ in range(full):
+            state = run(*state)
+    if rem:
+        state = runner_factory(rem)(*state)
+    return jax.block_until_ready(state)

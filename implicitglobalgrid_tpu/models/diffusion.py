@@ -95,13 +95,24 @@ def init_diffusion2d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, dtype=None):
     return T, Cp, DiffusionParams(lam=lam, dt=dt, dx=dx, dy=dy)
 
 
-def diffusion_step_local(T, Cp, p: DiffusionParams):
+def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
     """One time step on a LOCAL block (use inside shard_map) — the reference
-    hot loop verbatim (`diffusion3D_multicpu_novis.jl:41-47`), fused by XLA:
+    hot loop (`diffusion3D_multicpu_novis.jl:41-47`):
 
         q = -λ ∇T;   δT/δt = -∇·q / cₚ;   T += dt δT/δt;   update_halo(T)
+
+    ``impl``: "xla" (broadcast flux form, fused by XLA) or "pallas" (fused
+    single-pass Pallas TPU kernel, same arithmetic to the last ulp;
+    "pallas_interpret" for CPU testing). 3-D only for pallas.
     """
-    if T.ndim == 3:
+    if impl.startswith("pallas") and T.ndim == 3:
+        from ..ops.pallas_stencil import diffusion3d_step_pallas
+
+        T = diffusion3d_step_pallas(
+            T, Cp, lam=p.lam, dt=p.dt, dx=p.dx, dy=p.dy, dz=p.dz,
+            interpret=(impl == "pallas_interpret"),
+        )
+    elif T.ndim == 3:
         qx = -p.lam * d_xi(T) / p.dx
         qy = -p.lam * d_yi(T) / p.dy
         qz = -p.lam * d_zi(T) / p.dz
@@ -115,7 +126,19 @@ def diffusion_step_local(T, Cp, p: DiffusionParams):
     return local_update_halo(T)
 
 
-def make_step(p: DiffusionParams, ndim: int = 3):
+def _resolve_impl(impl):
+    """Default impl: the grid's IGG_USE_PALLAS flag (the analog of the
+    reference's per-dimension copy-kernel toggle IGG_USE_POLYESTER,
+    `init_global_grid.jl:60,71-75`) selects the Pallas kernels on TPU."""
+    if impl is not None:
+        return impl
+    gg = global_grid()
+    if bool(gg.use_pallas.any()) and gg.device_type == "tpu":
+        return "pallas"
+    return "xla"
+
+
+def make_step(p: DiffusionParams, ndim: int = 3, impl: str | None = None):
     """Controller-level jitted single step on stacked arrays:
     ``T = step(T, Cp)``."""
     import jax
@@ -123,62 +146,45 @@ def make_step(p: DiffusionParams, ndim: int = 3):
     check_initialized()
     gg = global_grid()
     spec = field_partition_spec(ndim)
+    impl = _resolve_impl(impl)
 
     def local(T, Cp):
-        return diffusion_step_local(T, Cp, p)
+        return diffusion_step_local(T, Cp, p, impl)
 
     return jax.jit(jax.shard_map(
-        local, mesh=gg.mesh, in_specs=(spec, spec), out_specs=spec
+        local, mesh=gg.mesh, in_specs=(spec, spec), out_specs=spec,
+        check_vma=not impl.startswith("pallas"),
     ))
 
 
-# Compiled whole-loop programs, keyed by (grid epoch, params, chunk, ndim) —
-# same pattern as the halo exchange cache (ops/halo.py): jit caches by
-# function identity, so rebuilding the closure per call would recompile.
-_run_cache: dict = {}
-
-
-def make_run(p: DiffusionParams, nt_chunk: int, ndim: int = 3):
+def make_run(p: DiffusionParams, nt_chunk: int, ndim: int = 3,
+             impl: str | None = None):
     """Whole-loop runner: ONE compiled program advancing ``nt_chunk`` steps
     (`lax.fori_loop` with the halo ppermutes inline) — the TPU-first
-    replacement for the reference's per-step dispatch loop. Cached across
-    calls per grid epoch."""
-    import jax
-    from jax import lax
+    replacement for the reference's per-step dispatch loop. Built on the
+    shared epoch-cached runner machinery (`models/common.py`); the state is
+    ``(T, Cp)`` with ``Cp`` carried through unchanged."""
+    from .common import make_state_runner
 
-    check_initialized()
-    gg = global_grid()
-    key = (gg.epoch, p, int(nt_chunk), int(ndim))
-    fn = _run_cache.get(key)
-    if fn is not None:
-        return fn
-    if _run_cache and next(iter(_run_cache))[0] != gg.epoch:
-        _run_cache.clear()  # stale grids
-    spec = field_partition_spec(ndim)
+    impl = _resolve_impl(impl)
 
-    def chunk(T, Cp):
-        return lax.fori_loop(
-            0, nt_chunk, lambda i, Tc: diffusion_step_local(Tc, Cp, p), T
-        )
+    def step(state):
+        T, Cp = state
+        return diffusion_step_local(T, Cp, p, impl), Cp
 
-    fn = jax.jit(jax.shard_map(
-        chunk, mesh=gg.mesh, in_specs=(spec, spec), out_specs=spec
-    ))
-    _run_cache[key] = fn
-    return fn
+    return make_state_runner(
+        step, (ndim, ndim), nt_chunk=nt_chunk,
+        key=("diffusion", p, impl),
+        check_vma=not impl.startswith("pallas"),
+    )
 
 
-def run_diffusion(T, Cp, p: DiffusionParams, nt: int, *, nt_chunk: int = 100):
+def run_diffusion(T, Cp, p: DiffusionParams, nt: int, *, nt_chunk: int = 100,
+                  impl: str | None = None):
     """Advance ``nt`` steps, compiling at most two chunk sizes."""
-    import jax
+    from .common import run_chunked
 
     ndim = T.ndim
-    full_chunks, rem = divmod(nt, nt_chunk)
-    if full_chunks:
-        run = make_run(p, nt_chunk, ndim)
-        for _ in range(full_chunks):
-            T = run(T, Cp)
-    if rem:
-        run_r = make_run(p, rem, ndim)
-        T = run_r(T, Cp)
-    return jax.block_until_ready(T)
+    T, Cp = run_chunked(lambda c: make_run(p, c, ndim, impl), (T, Cp),
+                        nt, nt_chunk)
+    return T

@@ -1,0 +1,192 @@
+"""3-D pseudo-transient (PT) Stokes solver on a staggered grid.
+
+The BASELINE config "3-D pseudo-transient Stokes solver, weak-scale to
+v5p-256" (`/root/repo/BASELINE.json`): isoviscous, incompressible Stokes flow
+driven by a buoyant spherical inclusion, solved by damped pseudo-transient
+iteration — the hydro-mechanical miniapp family the reference's weak-scaling
+figure is built on (`reference README.md:6-8`). Built entirely on the
+framework's staggered-field machinery (per-field overlaps `shared.jl:107`):
+
+    cell centers: P, τxx, τyy, τzz, ρg      faces: Vx, Vy, Vz
+    edges: τxy, τxz, τyz
+
+    divV = ∇·V
+    P   ← P − dτ_P divV
+    τii ← 2μ (∂iVi − divV/3)
+    τij ← μ (∂jVi + ∂iVj)
+    R_i = −∂iP + ∂jτij (+ buoyancy)
+    dV  ← damp·dV + R          (damped PT momentum)
+    V   ← V + dτ_V dV
+    halo-exchange V (and P)
+
+One PT iteration is one `step_local` inside the whole-loop-jitted runner;
+convergence is monitored by `residuals` (max |divV|, max |R|) — psum-reduced
+across the mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..ops.alloc import device_put_g, zeros_g
+from ..ops.halo import local_update_halo
+from ..parallel.topology import AXIS_NAMES, check_initialized, global_grid
+from ..tools import coords_g, nx_g, ny_g, nz_g
+from .common import make_state_runner, run_chunked
+
+__all__ = ["StokesParams", "init_stokes3d", "stokes_step_local",
+           "make_stokes_run", "run_stokes", "stokes_residuals"]
+
+
+@dataclass(frozen=True)
+class StokesParams:
+    mu: float       # shear viscosity
+    dt_v: float     # pseudo time step, momentum
+    dt_p: float     # pseudo time step, pressure
+    damp: float     # PT damping factor
+    dx: float
+    dy: float
+    dz: float
+
+
+def init_stokes3d(*, mu=1.0, lx=10.0, ly=10.0, lz=10.0, rhog_mag=1.0,
+                  r_incl=1.0, dtype=None):
+    """State (P, Vx, Vy, Vz, dVx, dVy, dVz, rhog): zero initial flow, a
+    buoyant sphere of radius ``r_incl`` at the domain center."""
+    check_initialized()
+    gg = global_grid()
+    nx, ny, nz = (int(n) for n in gg.nxyz)
+    dx, dy, dz = lx / (nx_g() - 1), ly / (ny_g() - 1), lz / (nz_g() - 1)
+    # standard PT scalings (damped wave equation analogy)
+    min_d = min(dx, dy, dz)
+    n_max = max(nx_g(), ny_g(), nz_g())
+    dt_v = min_d ** 2 / mu / 6.1 / 2.0
+    dt_p = 6.1 * mu / n_max
+    damp = 1.0 - 6.0 / n_max
+
+    P = zeros_g((nx, ny, nz), dtype=dtype)
+    x, y, z = coords_g(dx, dy, dz, P)
+    r2 = ((np.asarray(x) - lx / 2) ** 2 + (np.asarray(y) - ly / 2) ** 2
+          + (np.asarray(z) - lz / 2) ** 2)
+    rhog = device_put_g(
+        np.broadcast_to((r2 < r_incl ** 2) * rhog_mag, P.shape).astype(P.dtype))
+    Vx = zeros_g((nx + 1, ny, nz), dtype=dtype)
+    Vy = zeros_g((nx, ny + 1, nz), dtype=dtype)
+    Vz = zeros_g((nx, ny, nz + 1), dtype=dtype)
+    dVx = zeros_g((nx - 1, ny - 2, nz - 2), dtype=dtype)
+    dVy = zeros_g((nx - 2, ny - 1, nz - 2), dtype=dtype)
+    dVz = zeros_g((nx - 2, ny - 2, nz - 1), dtype=dtype)
+    state = (P, Vx, Vy, Vz, dVx, dVy, dVz, rhog)
+    return state, StokesParams(mu=mu, dt_v=dt_v, dt_p=dt_p, damp=damp,
+                               dx=dx, dy=dy, dz=dz)
+
+
+def _d(A, d):
+    from jax import lax
+
+    n = A.shape[d]
+    return lax.slice_in_dim(A, 1, n, axis=d) - lax.slice_in_dim(A, 0, n - 1, axis=d)
+
+
+def _inner(A, dims_sel):
+    from jax import lax
+
+    for d in dims_sel:
+        A = lax.slice_in_dim(A, 1, A.shape[d] - 1, axis=d)
+    return A
+
+
+def _stokes_terms(state, p: StokesParams):
+    """Residuals R_i at interior faces (shared by step and monitor)."""
+    P, Vx, Vy, Vz, dVx, dVy, dVz, rhog = state
+    divV = _d(Vx, 0) / p.dx + _d(Vy, 1) / p.dy + _d(Vz, 2) / p.dz  # centers
+    Pn = P - p.dt_p * divV
+    txx = 2 * p.mu * (_d(Vx, 0) / p.dx - divV / 3)
+    tyy = 2 * p.mu * (_d(Vy, 1) / p.dy - divV / 3)
+    tzz = 2 * p.mu * (_d(Vz, 2) / p.dz - divV / 3)
+    # edge shear stresses on interior edges
+    txy = p.mu * (_inner(_d(Vx, 1), (0,)) / p.dy + _inner(_d(Vy, 0), (1,)) / p.dx)
+    txz = p.mu * (_inner(_d(Vx, 2), (0,)) / p.dz + _inner(_d(Vz, 0), (2,)) / p.dx)
+    tyz = p.mu * (_inner(_d(Vy, 2), (1,)) / p.dz + _inner(_d(Vz, 1), (2,)) / p.dy)
+
+    Rx = (_inner(_d(txx - Pn, 0), (1, 2)) / p.dx
+          + _d(_inner(txy, (2,)), 1) / p.dy
+          + _d(_inner(txz, (1,)), 2) / p.dz)
+    Ry = (_inner(_d(tyy - Pn, 1), (0, 2)) / p.dy
+          + _d(_inner(txy, (2,)), 0) / p.dx
+          + _d(_inner(tyz, (0,)), 2) / p.dz)
+    rg_face = 0.5 * (_d(rhog, 2) + 2 * rhog[:, :, :-1])  # avg to z-faces
+    Rz = (_inner(_d(tzz - Pn, 2), (0, 1)) / p.dz
+          + _d(_inner(txz, (1,)), 0) / p.dx
+          + _d(_inner(tyz, (0,)), 1) / p.dy
+          + _inner(rg_face, (0, 1)))
+    return Pn, divV, Rx, Ry, Rz
+
+
+def stokes_step_local(state, p: StokesParams):
+    """One damped PT iteration on LOCAL blocks (inside shard_map)."""
+    P, Vx, Vy, Vz, dVx, dVy, dVz, rhog = state
+    Pn, divV, Rx, Ry, Rz = _stokes_terms(state, p)
+    dVx = p.damp * dVx + Rx
+    dVy = p.damp * dVy + Ry
+    dVz = p.damp * dVz + Rz
+    Vx = Vx.at[1:-1, 1:-1, 1:-1].add(p.dt_v * dVx)
+    Vy = Vy.at[1:-1, 1:-1, 1:-1].add(p.dt_v * dVy)
+    Vz = Vz.at[1:-1, 1:-1, 1:-1].add(p.dt_v * dVz)
+    Vx, Vy, Vz, Pn = local_update_halo(Vx, Vy, Vz, Pn)
+    return (Pn, Vx, Vy, Vz, dVx, dVy, dVz, rhog)
+
+
+def make_stokes_run(p: StokesParams, nt_chunk: int):
+    return make_state_runner(
+        lambda s: stokes_step_local(s, p), (3,) * 8,
+        nt_chunk=nt_chunk, key=("stokes3d", p),
+    )
+
+
+def run_stokes(state, p: StokesParams, nt: int, *, nt_chunk: int = 100):
+    """Run ``nt`` PT iterations (one compiled program per chunk)."""
+    return run_chunked(lambda c: make_stokes_run(p, c), state, nt, nt_chunk)
+
+
+_residual_cache: dict = {}
+
+
+def stokes_residuals(state, p: StokesParams):
+    """Global (max |divV|, max |R|) — pmax-reduced over the mesh (the
+    convergence monitor of the PT loop). Compiled once per (grid, params)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as Pspec
+
+    check_initialized()
+    gg = global_grid()
+    key = (gg.epoch, p)
+    cached = _residual_cache.get(key)
+    if cached is not None:
+        a, b = cached(*state)
+        return float(a), float(b)
+    if _residual_cache and next(iter(_residual_cache))[0] != gg.epoch:
+        _residual_cache.clear()
+    spec = Pspec(*AXIS_NAMES)
+
+    def local(*s):
+        _, divV, Rx, Ry, Rz = _stokes_terms(tuple(s), p)
+        err_div = jnp.max(jnp.abs(divV))
+        err_mom = jnp.maximum(jnp.maximum(jnp.max(jnp.abs(Rx)),
+                                          jnp.max(jnp.abs(Ry))),
+                              jnp.max(jnp.abs(Rz)))
+        for ax in AXIS_NAMES:
+            err_div = lax.pmax(err_div, ax)
+            err_mom = lax.pmax(err_mom, ax)
+        return err_div, err_mom
+
+    fn = jax.jit(jax.shard_map(
+        local, mesh=gg.mesh, in_specs=(spec,) * 8,
+        out_specs=(Pspec(), Pspec())))
+    _residual_cache[key] = fn
+    a, b = fn(*state)
+    return float(a), float(b)

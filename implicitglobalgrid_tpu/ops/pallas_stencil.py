@@ -1,0 +1,125 @@
+"""Pallas TPU kernels for the diffusion stencil step.
+
+The reference's GPU extension hand-writes pack kernels (`write_d2x!`,
+`/root/reference/src/CUDAExt/update_halo.jl:210-227`) because CUDA broadcasts
+leave >10x on the table (`reference README.md:167`). The TPU analog of that
+native-kernel tier is Pallas: this module fuses one full diffusion time step
+(flux computation + divergence + update) into a single pass over the local
+block, pipelined plane-by-plane through VMEM — removing the intermediate
+full-array materializations the XLA broadcast formulation pays for.
+
+The arithmetic is the exact flux-form sequence of the reference example
+(`examples/diffusion3D_multicpu_novis.jl:42-46`):
+
+    qx = -λ dT/dx (faces);  dT/dt = -div q / cp;  T += dt dT/dt   (interior)
+
+in the same accumulation order as the XLA flux-form step, so results agree to
+the last ulp or two (exact bitwise equality across the two compilers is not
+guaranteed — fma contraction differs).
+
+Kernel shape requirements: 3-D local blocks, last dim a multiple of 128
+(lane width) and second-to-last a multiple of 8 for peak efficiency; other
+shapes work but pad internally in the Mosaic compiler. Use
+``diffusion3d_step_pallas(..., interpret=True)`` on CPU (tests).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+__all__ = ["diffusion3d_step_pallas", "pallas_supported"]
+
+
+def pallas_supported(T) -> bool:
+    """Whether the Pallas step kernel supports this local block."""
+    return T.ndim == 3 and T.shape[0] >= 3
+
+
+def _plane_kernel(Tm_ref, Tc_ref, Tp_ref, Cp_ref, out_ref, *,
+                  lam, dt, dx, dy, dz):
+    """Compute one x-plane of the updated temperature.
+
+    Inputs are (1, ny, nz) planes: x-1, x, x+1 of T and x of Cp. Boundary
+    planes (first/last x, and y/z edges) keep their input values — the
+    reference stencil updates the interior only
+    (`diffusion3D_multicpu_novis.jl:47` writes `T[2:end-1,2:end-1,2:end-1]`).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+    tm = Tm_ref[0]
+    tc = Tc_ref[0]
+    tp = Tp_ref[0]
+    cp = Cp_ref[0]
+    ny, nz = tc.shape
+
+    # Flux form in the EXACT arithmetic/accumulation order of the reference
+    # example (`-d_xa(qx)/dx - d_ya(qy)/dy - d_za(qz)/dz`, then `/Cp`, then
+    # `T + dt*dTdt`) so results are bitwise identical to the XLA flux-form
+    # step for the same dtype.
+    qxr = -lam * (tp - tc) / dx
+    qxl = -lam * (tc - tm) / dx
+    acc = -((qxr - qxl) / dx)                     # (ny, nz)
+
+    qy = -lam * (tc[1:, :] - tc[:-1, :]) / dy     # (ny-1, nz)
+    div_y = (qy[1:, :] - qy[:-1, :]) / dy         # (ny-2, nz)
+    acc = acc - jnp.pad(div_y, ((1, 1), (0, 0)))
+
+    qz = -lam * (tc[:, 1:] - tc[:, :-1]) / dz     # (ny, nz-1)
+    div_z = (qz[:, 1:] - qz[:, :-1]) / dz         # (ny, nz-2)
+    acc = acc - jnp.pad(div_z, ((0, 0), (1, 1)))
+
+    upd = tc + dt * (acc / cp)
+
+    row = lax.broadcasted_iota(jnp.int32, (ny, nz), 0)
+    col = lax.broadcasted_iota(jnp.int32, (ny, nz), 1)
+    interior_yz = (row > 0) & (row < ny - 1) & (col > 0) & (col < nz - 1)
+    interior_x = (i > 0) & (i < n - 1)
+    out_ref[0] = jnp.where(interior_yz & interior_x, upd, tc)
+
+
+def diffusion3d_step_pallas(T, Cp, *, lam, dt, dx, dy, dz, interpret=False):
+    """One fused diffusion step on a LOCAL 3-D block (no halo exchange —
+    compose with `local_update_halo`). Grid over x-planes; each program
+    streams 3 T-planes + 1 Cp-plane through VMEM and writes 1 plane."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nx, ny, nz = T.shape
+    plane = (1, ny, nz)
+
+    # Physics constants are baked into the kernel as compile-time Python
+    # floats (pallas forbids captured traced values), cast to the block dtype
+    # at trace time inside the kernel.
+    dtp = T.dtype.type
+    kernel = partial(
+        _plane_kernel,
+        lam=dtp(lam), dt=dtp(dt), dx=dtp(dx), dy=dtp(dy), dz=dtp(dz),
+    )
+
+    def clamp(f):
+        return lambda i: (jnp.clip(f(i), 0, nx - 1), 0, 0)
+
+    try:  # inside shard_map, outputs must declare their mesh-axis variance
+        out_shape = jax.ShapeDtypeStruct(T.shape, T.dtype, vma=jax.typeof(T).vma)
+    except (AttributeError, TypeError):
+        out_shape = jax.ShapeDtypeStruct(T.shape, T.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nx,),
+        in_specs=[
+            pl.BlockSpec(plane, clamp(lambda i: i - 1)),
+            pl.BlockSpec(plane, clamp(lambda i: i)),
+            pl.BlockSpec(plane, clamp(lambda i: i + 1)),
+            pl.BlockSpec(plane, clamp(lambda i: i)),
+        ],
+        out_specs=pl.BlockSpec(plane, lambda i: (i, 0, 0)),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(T, T, T, Cp)

@@ -10,10 +10,13 @@ an explanatory error (mirroring the reference's legacy-var rejection at
 `init_global_grid.jl:57`). The TPU-meaningful knobs are:
 
 - ``IGG_TPU_PLATFORM``: force the JAX backend platform ("tpu", "cpu", "gpu").
-- ``IGG_USE_PALLAS`` (+ ``_DIMX/_DIMY/_DIMZ``): use Pallas pack/unpack kernels
-  for the halo slabs instead of plain XLA slicing (analog of the reference's
-  per-dimension `IGG_USE_POLYESTER` copy-kernel toggle,
-  `init_global_grid.jl:60,71-75`).
+- ``IGG_USE_PALLAS`` (+ ``_DIMX/_DIMY/_DIMZ``): prefer the hand-written
+  Pallas TPU kernels where they exist (analog of the reference's
+  copy-kernel toggle `IGG_USE_POLYESTER`, `init_global_grid.jl:60,71-75`).
+  Currently selects the fused Pallas stencil step in the models when ANY
+  flag is set on a TPU grid (`models.diffusion._resolve_impl`); the per-dim
+  refinements are recorded on the grid for the future per-dimension halo
+  pack path.
 - ``IGG_TPU_DCN_AXES``: comma-separated mesh axes ("x","y","z") that cross
   slice boundaries (DCN) in a multi-slice deployment.
 """
@@ -80,14 +83,6 @@ def read_env_config() -> EnvConfig:
         v = _env_flag("IGG_USE_PALLAS" + sfx)
         if v is not None:
             cfg.use_pallas[d] = v
-    if any(cfg.use_pallas):
-        import warnings
-
-        warnings.warn(
-            "IGG_USE_PALLAS: the Pallas halo pack path is not wired into the "
-            "exchange yet; the flag is recorded on the grid but XLA slicing is used.",
-            stacklevel=3,
-        )
 
     axes = os.environ.get("IGG_TPU_DCN_AXES", "")
     if axes:
