@@ -1,0 +1,66 @@
+"""Tests of `finalize_global_grid` — analog of the reference's
+`test/test_finalize_global_grid.jl` (finalization resets the singleton;
+finalize-before-init throws), widened with the TPU-specific teardown
+obligations: the compiled-exchange cache (the buffer-pool analog,
+reference `update_halo.jl:103-108`) and the timing probes are freed,
+and re-initialization afterwards works.
+"""
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.ops import halo
+from implicitglobalgrid_tpu.utils import timing
+from implicitglobalgrid_tpu.utils.exceptions import NotInitializedError
+
+
+def test_finalize_resets_singleton():
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    assert igg.grid_is_initialized()
+    igg.finalize_global_grid()
+    assert not igg.grid_is_initialized()
+
+
+def test_finalize_before_init_throws():
+    # Finalize can never come before initialize (reference test 2).
+    assert not igg.grid_is_initialized()
+    with pytest.raises(NotInitializedError):
+        igg.finalize_global_grid()
+
+
+def test_finalize_frees_exchange_cache_and_probes():
+    igg.init_global_grid(5, 5, 5, periodx=1, periody=1, periodz=1, quiet=True)
+    A = igg.zeros_g()
+    igg.update_halo(A)
+    igg.tic(); igg.toc()
+    assert len(halo._exchange_cache) > 0
+    assert len(timing._probe_cache) > 0
+    igg.finalize_global_grid()
+    assert len(halo._exchange_cache) == 0
+    assert len(timing._probe_cache) == 0
+
+
+def test_reinit_after_finalize():
+    # Each reference test file re-inits/finalizes many times in one process
+    # (init_MPI=false pattern) — the lifecycle must be fully cyclable.
+    for nx in (4, 6, 8):
+        igg.init_global_grid(nx, nx, nx, periodx=1, quiet=True)
+        A = igg.ones_g()
+        A = igg.update_halo(A)
+        gg = igg.global_grid()
+        assert np.asarray(igg.gather(A)).shape == tuple(
+            int(d * n) for d, n in zip(gg.dims, gg.nxyz)
+        )
+        assert np.asarray(igg.gather_interior(A)).shape == (
+            igg.nx_g(), igg.ny_g(), igg.nz_g(),
+        )
+        igg.finalize_global_grid()
+        assert not igg.grid_is_initialized()
+
+
+def test_double_finalize_throws():
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    igg.finalize_global_grid()
+    with pytest.raises(NotInitializedError):
+        igg.finalize_global_grid()
