@@ -1,0 +1,85 @@
+"""Benchmark: `update_halo` effective GB/s per chip.
+
+First metric of BASELINE.json ("update_halo! effective GB/s/chip"); the
+reference claims "halo updates close to hardware limit" qualitatively
+(`reference README.md:10,30`) with no number published.
+
+Accounting (effective-bandwidth convention): per exchanged dimension, each
+chip sends 2 slabs and receives 2 slabs of ``hw x plane`` cells, i.e.
+``bytes/call = sum_dims 4 * hw * plane_cells * itemsize``. Periodic on all
+dims so every chip exchanges on every side (single chip: the self-neighbor
+local-copy path, the reference's 1-process test technique).
+
+Prints ONE JSON line.
+
+Usage: python bench_halo.py          (real chip, f32, 512^3 local)
+       python bench_halo.py --cpu    (small smoke run on virtual CPU mesh)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    cpu = "--cpu" in sys.argv
+    if cpu:
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+
+    if cpu:
+        nx, reps = 64, 20
+        dims = (2, 2, 2)
+    else:
+        nx, reps = 512, 200
+        nd = len(jax.devices())
+        dims = tuple(int(d) for d in igg.dims_create(nd, (0, 0, 0)))
+
+    igg.init_global_grid(nx, nx, nx, dimx=dims[0], dimy=dims[1], dimz=dims[2],
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    gg = igg.global_grid()
+    hw = [int(h) for h in gg.halowidths]
+    A = igg.ones_g((nx, nx, nx), np.float32)
+
+    def sync(x):
+        return float(jnp.sum(x))
+
+    A = igg.update_halo(A)  # compile
+    sync(A)
+
+    igg.tic()
+    for _ in range(reps):
+        A = igg.update_halo(A)
+    sync(A)
+    t = igg.toc()
+
+    itemsize = 4
+    planes = [nx * nx] * 3  # local plane cells per dim (cubic block)
+    bytes_per_call = sum(4 * hw[d] * planes[d] * itemsize for d in range(3))
+    gbps = bytes_per_call * reps / t / 1e9
+    # No published reference number exists (BASELINE.md: qualitative claim
+    # only); vs_baseline is vs 1 GB/s/chip as a nominal floor.
+    print(json.dumps({
+        "metric": "update_halo_effective_GBps_per_chip",
+        "value": gbps,
+        "unit": "GB/s/chip",
+        "vs_baseline": gbps / 1.0,
+    }))
+    igg.finalize_global_grid()
+
+
+if __name__ == "__main__":
+    main()

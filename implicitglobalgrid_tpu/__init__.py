@@ -48,6 +48,7 @@ from .tools import (
     nx_g, ny_g, nz_g, x_g, y_g, z_g, x_g_vec, y_g_vec, z_g_vec, coords_g,
 )
 from .utils.timing import tic, toc, barrier
+from .utils.checkpoint import save_checkpoint, restore_checkpoint, load_checkpoint
 from .utils import exceptions
 
 __version__ = "0.1.0"
@@ -61,6 +62,7 @@ __all__ = [
     "zeros_g", "ones_g", "full_g", "device_put_g", "sharding_of",
     "Field", "wrap_field", "extract", "local_shape_of", "stacked_shape",
     "x_g_vec", "y_g_vec", "z_g_vec", "coords_g",
+    "save_checkpoint", "restore_checkpoint", "load_checkpoint",
     "d_xa", "d_ya", "d_za", "d_xi", "d_yi", "d_zi", "inn",
     # state/introspection
     "AXIS_NAMES", "NDIMS", "PROC_NULL", "GlobalGrid", "global_grid",

@@ -1,0 +1,125 @@
+"""Checkpoint/resume for distributed grid state.
+
+The reference has NO checkpointing (SURVEY §5.4): `gather!` is the provided
+IO primitive (`/root/reference/src/gather.jl`) and users handle files. Here
+checkpointing is first-class: functional state (stacked global `jax.Array`s)
+plus the recorded grid topology make save/restore a pair of calls::
+
+    igg.save_checkpoint("ckpt.npz", {"T": T, "Cp": Cp}, step=it)
+    state, step = igg.restore_checkpoint("ckpt.npz")     # arrays re-sharded
+    T, Cp = state["T"], state["Cp"]
+
+Format: one `.npz` (portable, numpy-readable anywhere) holding the gathered
+stacked arrays plus the grid topology (`nxyz`, `dims`, `overlaps`, `periods`,
+`halowidths`). `restore_checkpoint` validates the topology against the live
+grid and re-shards each array onto the current mesh (`device_put_g`), so a
+run can resume on different hardware with the same decomposition. In
+multi-host runs the gather is collective (every process must call save) and
+only the ``root`` process writes; restore is SPMD-uniform.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..parallel.topology import check_initialized, global_grid
+from ..utils.exceptions import IncoherentArgumentError, InvalidArgumentError
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "load_checkpoint"]
+
+_META_PREFIX = "__igg_meta__"
+_ARR_PREFIX = "__igg_arr__"
+
+
+def _grid_meta(gg) -> dict:
+    return {
+        f"{_META_PREFIX}nxyz": np.asarray(gg.nxyz, dtype=np.int64),
+        f"{_META_PREFIX}dims": np.asarray(gg.dims, dtype=np.int64),
+        f"{_META_PREFIX}overlaps": np.asarray(gg.overlaps, dtype=np.int64),
+        f"{_META_PREFIX}periods": np.asarray(gg.periods, dtype=np.int64),
+        f"{_META_PREFIX}halowidths": np.asarray(gg.halowidths, dtype=np.int64),
+    }
+
+
+def save_checkpoint(path, state: dict, *, step: int | None = None,
+                    root: int = 0) -> None:
+    """Write ``state`` (a dict name -> stacked global array) and the grid
+    topology to ``path`` (.npz). Collective in multi-host runs; only ``root``
+    writes the file. Writes atomically (tmp file + rename) so an interrupted
+    save never corrupts an existing checkpoint."""
+    import jax
+
+    from ..ops.gather import gather
+
+    check_initialized()
+    if not isinstance(state, dict) or not state:
+        raise InvalidArgumentError(
+            "save_checkpoint expects a non-empty dict of name -> array.")
+    for k in state:
+        if not isinstance(k, str) or k.startswith("__igg_"):
+            raise InvalidArgumentError(
+                f"Invalid state key {k!r}: keys must be strings not starting "
+                "with '__igg_'.")
+    gg = global_grid()
+    # Gather every array on every process (collective), write on root only.
+    hosts = {k: gather(v, root=root) for k, v in state.items()}
+    if jax.process_index() == root:
+        payload = {f"{_ARR_PREFIX}{k}": np.asarray(v) for k, v in hosts.items()}
+        payload.update(_grid_meta(gg))
+        if step is not None:
+            payload[f"{_META_PREFIX}step"] = np.int64(step)
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    # All processes synchronize on the completed write so an immediately
+    # following restore_checkpoint never reads a stale/missing file on
+    # non-root hosts (save/restore is documented as an SPMD-uniform pair).
+    from .timing import barrier
+
+    barrier()
+
+
+def load_checkpoint(path):
+    """Read a checkpoint file: returns ``(state, meta)`` with ``state`` a dict
+    of numpy arrays (stacked layout) and ``meta`` the saved topology dict
+    (keys: nxyz, dims, overlaps, periods, halowidths, step|None). Host-only —
+    does not require an initialized grid."""
+    if not os.path.exists(path):
+        raise InvalidArgumentError(f"Checkpoint file not found: {path}")
+    with np.load(path) as z:
+        state = {k[len(_ARR_PREFIX):]: z[k] for k in z.files
+                 if k.startswith(_ARR_PREFIX)}
+        meta = {k[len(_META_PREFIX):]: z[k] for k in z.files
+                if k.startswith(_META_PREFIX)}
+    meta["step"] = int(meta["step"]) if "step" in meta else None
+    return state, meta
+
+
+def restore_checkpoint(path, *, strict: bool = True):
+    """Load ``path`` and re-shard every array onto the live grid's mesh.
+
+    Returns ``(state, step)`` with ``state`` a dict of stacked global
+    `jax.Array`s. With ``strict`` (default) the saved topology (``nxyz, dims,
+    overlaps, periods, halowidths``) must match the live grid exactly;
+    ``strict=False`` skips the check (e.g. resuming onto a different
+    decomposition of the same global grid — caller's responsibility)."""
+    from ..ops.alloc import device_put_g
+
+    check_initialized()
+    gg = global_grid()
+    state, meta = load_checkpoint(path)
+    if strict:
+        for name in ("nxyz", "dims", "overlaps", "periods", "halowidths"):
+            saved = meta.get(name)
+            live = np.asarray(getattr(gg, name))
+            if saved is None or not np.array_equal(np.asarray(saved), live):
+                raise IncoherentArgumentError(
+                    f"Checkpoint topology mismatch for `{name}`: saved "
+                    f"{None if saved is None else list(np.asarray(saved))}, live "
+                    f"{list(live)}. Re-init the grid to match or pass strict=False."
+                )
+    out = {k: device_put_g(v) for k, v in state.items()}
+    return out, meta["step"]

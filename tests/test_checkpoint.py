@@ -1,0 +1,87 @@
+"""Checkpoint/resume tests (first-class subsystem here; the reference has
+none — SURVEY §5.4, `gather!` is its only IO primitive)."""
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.utils.exceptions import (
+    IncoherentArgumentError, InvalidArgumentError,
+)
+
+
+def _init(**kw):
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, periodx=1,
+                         quiet=True, **kw)
+
+
+def test_save_restore_roundtrip(tmp_path):
+    _init()
+    p = str(tmp_path / "ckpt.npz")
+    T = igg.device_put_g(np.arange(1000, dtype=np.float64).reshape(10, 10, 10))
+    Cp = igg.ones_g()
+    igg.save_checkpoint(p, {"T": T, "Cp": Cp}, step=42)
+    state, step = igg.restore_checkpoint(p)
+    assert step == 42
+    assert np.array_equal(np.asarray(state["T"]), np.asarray(T))
+    assert np.array_equal(np.asarray(state["Cp"]), np.asarray(Cp))
+    # restored arrays carry the grid sharding (usable in update_halo directly)
+    r = igg.update_halo(state["T"])
+    assert np.asarray(r).shape == (10, 10, 10)
+
+
+def test_resume_continues_simulation(tmp_path):
+    from implicitglobalgrid_tpu.models import init_diffusion3d, run_diffusion
+
+    _init()
+    p = str(tmp_path / "ckpt.npz")
+    T, Cp, prm = init_diffusion3d(dtype=np.float64)
+    T10 = run_diffusion(T, Cp, prm, 10, nt_chunk=5)
+    igg.save_checkpoint(p, {"T": T10, "Cp": Cp}, step=10)
+    # resume and advance 5 more == straight 15
+    state, step = igg.restore_checkpoint(p)
+    T15_resumed = run_diffusion(state["T"], state["Cp"], prm, 5, nt_chunk=5)
+    T15_straight = run_diffusion(T10, Cp, prm, 5, nt_chunk=5)
+    assert np.allclose(np.asarray(T15_resumed), np.asarray(T15_straight),
+                       rtol=0, atol=0)
+
+
+def test_load_without_grid(tmp_path):
+    _init()
+    p = str(tmp_path / "ckpt.npz")
+    igg.save_checkpoint(p, {"A": igg.ones_g()})
+    igg.finalize_global_grid()
+    state, meta = igg.load_checkpoint(p)  # host-only read, no grid needed
+    assert state["A"].shape == (10, 10, 10)
+    assert list(meta["dims"]) == [2, 2, 2]
+    assert meta["step"] is None
+
+
+def test_topology_mismatch_rejected(tmp_path):
+    _init()
+    p = str(tmp_path / "ckpt.npz")
+    igg.save_checkpoint(p, {"A": igg.ones_g()}, step=1)
+    igg.finalize_global_grid()
+    # same stacked shape, different topology (periods) ⇒ strict must reject
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, quiet=True)
+    with pytest.raises(IncoherentArgumentError):
+        igg.restore_checkpoint(p)
+    # non-strict: caller takes responsibility; same stacked shape re-shards fine
+    state, step = igg.restore_checkpoint(p, strict=False)
+    assert step == 1
+    assert np.asarray(state["A"]).shape == (10, 10, 10)
+
+
+def test_atomic_overwrite_and_errors(tmp_path):
+    _init()
+    p = str(tmp_path / "ckpt.npz")
+    igg.save_checkpoint(p, {"A": igg.ones_g()}, step=1)
+    igg.save_checkpoint(p, {"A": igg.ones_g() * 2}, step=2)  # overwrite OK
+    state, step = igg.restore_checkpoint(p)
+    assert step == 2 and float(np.asarray(state["A"])[0, 0, 0]) == 2.0
+    with pytest.raises(InvalidArgumentError):
+        igg.save_checkpoint(p, {})
+    with pytest.raises(InvalidArgumentError):
+        igg.save_checkpoint(p, {"__igg_bad": igg.ones_g()})
+    with pytest.raises(InvalidArgumentError):
+        igg.restore_checkpoint(str(tmp_path / "missing.npz"))
