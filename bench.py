@@ -42,7 +42,7 @@ def main() -> None:
         dims = (2, 2, 2)
     else:
         nx = 256
-        nt = 400
+        nt = 2000
         nd = len(jax.devices())
         dims = tuple(int(d) for d in igg.dims_create(nd, (0, 0, 0)))
 
@@ -53,14 +53,9 @@ def main() -> None:
     chunk = max(1, nt // 4)
     run = make_run(p, nt_chunk=chunk)
 
-    # warmup/compile (sync via a data-dependent scalar fetch: on the axon
-    # tunnel, block_until_ready can return before execution finishes)
-    import jax.numpy as jnp
-
-    def sync(x):
-        return float(jnp.sum(x))
-
-    sync(run(T, Cp)[0])
+    # warmup/compile; igg.sync is a data-dependent drain (block_until_ready
+    # can return early on the axon tunnel)
+    igg.sync(run(T, Cp))
 
     igg.tic()
     Tc = T
@@ -68,8 +63,7 @@ def main() -> None:
     while steps < nt:
         Tc, _ = run(Tc, Cp)
         steps += chunk
-    sync(Tc)
-    t = igg.toc()
+    t = igg.toc(sync_on=Tc)
 
     cells = float(igg.nx_g()) * float(igg.ny_g()) * float(igg.nz_g())
     rate = cells * steps / t

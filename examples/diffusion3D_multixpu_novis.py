@@ -42,10 +42,17 @@ def diffusion3D():
                                 dtype=jnp.float32)
 
     # Whole time loop as one compiled program per chunk (TPU-first hot loop;
-    # replaces the reference's per-step broadcast dispatches :41-48)
+    # replaces the reference's per-step broadcast dispatches :41-48).
+    # One-chunk warmup (same chunk size ⇒ same cached program) so tic/toc
+    # measures steady state, not XLA compilation. run_diffusion returns only
+    # after the work drained (data-dependent sync inside run_chunked).
+    chunk = max(1, nt // 10)
+    run_diffusion(T, Cp, p, chunk, nt_chunk=chunk)
+    if nt % chunk:  # remainder chunk is a second program — warm it too
+        run_diffusion(T, Cp, p, nt % chunk, nt_chunk=chunk)
     igg.tic()
-    T = run_diffusion(T, Cp, p, nt, nt_chunk=max(1, nt // 10))
-    t = igg.toc()
+    T = run_diffusion(T, Cp, p, nt, nt_chunk=chunk)
+    t = igg.toc(sync_on=T)
 
     cells = igg.nx_g() * igg.ny_g() * igg.nz_g()
     G = igg.gather_interior(T)   # collective in multi-host: every process calls it

@@ -56,8 +56,10 @@ def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
 
 def run_chunked(runner_factory, state, nt: int, nt_chunk: int):
     """Advance ``nt`` steps using ``runner_factory(chunk_size)``; compiles at
-    most two chunk sizes."""
-    import jax
+    most two chunk sizes. Returns only after the work actually finished
+    (data-dependent `sync` — `block_until_ready` is not a reliable drain on
+    all PJRT transports, see `utils.timing.sync`)."""
+    from ..utils.timing import sync
 
     full, rem = divmod(nt, nt_chunk)
     if full:
@@ -66,4 +68,4 @@ def run_chunked(runner_factory, state, nt: int, nt_chunk: int):
             state = run(*state)
     if rem:
         state = runner_factory(rem)(*state)
-    return jax.block_until_ready(state)
+    return sync(state)

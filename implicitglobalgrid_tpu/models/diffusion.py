@@ -126,14 +126,18 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
     return local_update_halo(T)
 
 
-def _resolve_impl(impl):
+def _resolve_impl(impl, ndim=3):
     """Default impl: the grid's IGG_USE_PALLAS flag (the analog of the
     reference's per-dimension copy-kernel toggle IGG_USE_POLYESTER,
-    `init_global_grid.jl:60,71-75`) selects the Pallas kernels on TPU."""
+    `init_global_grid.jl:60,71-75`) selects the Pallas kernels on TPU grids
+    (on by default there). Only the 3-D step has a Pallas kernel — other
+    ndims resolve to the XLA path so check_vma stays on for them. The fused
+    step kernel covers all dims at once, so ANY explicit per-dim opt-out
+    (e.g. IGG_USE_PALLAS_DIMX=0) falls back to the XLA path."""
     if impl is not None:
         return impl
     gg = global_grid()
-    if bool(gg.use_pallas.any()) and gg.device_type == "tpu":
+    if ndim == 3 and bool(gg.use_pallas.all()) and gg.device_type == "tpu":
         return "pallas"
     return "xla"
 
@@ -146,7 +150,7 @@ def make_step(p: DiffusionParams, ndim: int = 3, impl: str | None = None):
     check_initialized()
     gg = global_grid()
     spec = field_partition_spec(ndim)
-    impl = _resolve_impl(impl)
+    impl = _resolve_impl(impl, ndim)
 
     def local(T, Cp):
         return diffusion_step_local(T, Cp, p, impl)
@@ -166,7 +170,7 @@ def make_run(p: DiffusionParams, nt_chunk: int, ndim: int = 3,
     ``(T, Cp)`` with ``Cp`` carried through unchanged."""
     from .common import make_state_runner
 
-    impl = _resolve_impl(impl)
+    impl = _resolve_impl(impl, ndim)
 
     def step(state):
         T, Cp = state

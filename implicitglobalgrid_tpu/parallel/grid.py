@@ -186,7 +186,12 @@ def init_global_grid(
         nxyz_g=nxyz_g, nxyz=nxyz, dims=dims, overlaps=overlaps,
         halowidths=halowidths, nprocs=nprocs, me=me, coords=coords,
         periods=periods, disp=int(disp), reorder=int(reorder), mesh=mesh,
-        device_type=resolved_type, use_pallas=np.array(cfg.use_pallas, dtype=bool),
+        device_type=resolved_type,
+        # Pallas kernel tier: on by default on TPU (measured ~3x over the
+        # broadcast form — bench.py), explicit IGG_USE_PALLAS[=0] overrides.
+        use_pallas=np.array(
+            [(resolved_type == "tpu") if v is None else v for v in cfg.use_pallas],
+            dtype=bool),
         dcn_axes=cfg.dcn_axes, quiet=bool(quiet),
     )
     set_global_grid(gg)

@@ -10,13 +10,15 @@ an explanatory error (mirroring the reference's legacy-var rejection at
 `init_global_grid.jl:57`). The TPU-meaningful knobs are:
 
 - ``IGG_TPU_PLATFORM``: force the JAX backend platform ("tpu", "cpu", "gpu").
-- ``IGG_USE_PALLAS`` (+ ``_DIMX/_DIMY/_DIMZ``): prefer the hand-written
+- ``IGG_USE_PALLAS`` (+ ``_DIMX/_DIMY/_DIMZ``): select the hand-written
   Pallas TPU kernels where they exist (analog of the reference's
   copy-kernel toggle `IGG_USE_POLYESTER`, `init_global_grid.jl:60,71-75`).
-  Currently selects the fused Pallas stencil step in the models when ANY
-  flag is set on a TPU grid (`models.diffusion._resolve_impl`); the per-dim
-  refinements are recorded on the grid for the future per-dimension halo
-  pack path.
+  Unlike the reference's opt-in default, the Pallas tier is ON by default on
+  TPU grids (it is ~3x faster than the broadcast form there — see bench.py);
+  set ``IGG_USE_PALLAS=0`` to force the pure-XLA path. Selects the fused
+  Pallas stencil step in the models (`models.diffusion._resolve_impl`); the
+  per-dim refinements are recorded on the grid for the future per-dimension
+  halo pack path.
 - ``IGG_TPU_DCN_AXES``: comma-separated mesh axes ("x","y","z") that cross
   slice boundaries (DCN) in a multi-slice deployment.
 """
@@ -54,7 +56,9 @@ def _env_flag(name: str) -> bool | None:
 @dataclass
 class EnvConfig:
     platform: str | None = None            # IGG_TPU_PLATFORM
-    use_pallas: list = field(default_factory=lambda: [False, False, False])
+    use_pallas: list = field(default_factory=lambda: [None, None, None])
+    # tri-state per dim: None = unset (resolved at init: True on TPU grids,
+    # False elsewhere), True/False = explicit env setting
     dcn_axes: tuple = ()                   # IGG_TPU_DCN_AXES
 
 

@@ -15,10 +15,36 @@ import time
 
 from ..parallel.topology import AXIS_NAMES, check_initialized, global_grid
 
-__all__ = ["tic", "toc", "barrier", "init_timing_functions"]
+__all__ = ["tic", "toc", "barrier", "sync", "init_timing_functions"]
 
 _t0 = None
 _probe_cache: dict = {}
+
+
+def sync(tree):
+    """Force completion of every computation producing ``tree``'s arrays and
+    return ``tree``.
+
+    Stronger than ``jax.block_until_ready``: fetches ONE element of every
+    device shard, which cannot resolve before that device's producing program
+    finishes. Needed because some PJRT transports (e.g. the axon TPU tunnel)
+    let ``block_until_ready`` — and even independent barrier programs —
+    return before queued work completes; a concrete value fetch is the only
+    ordering guarantee that holds everywhere. Cost: one scalar D2H per shard.
+
+    Works for multi-host arrays too: the global array cannot be eagerly
+    indexed when not fully addressable, but each ``shard.data`` is a local
+    single-device array and fetching from it is always legal.
+    """
+    import jax
+    import numpy as np
+
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            for shard in leaf.addressable_shards:
+                d = shard.data
+                np.asarray(d[(0,) * d.ndim] if d.ndim else d)
+    return tree
 
 
 def _device_barrier() -> None:
@@ -50,25 +76,35 @@ def _device_barrier() -> None:
         multihost_utils.sync_global_devices("igg_tpu_barrier")
 
 
-def barrier() -> None:
-    """Block until all devices (and processes) reach this point."""
+def barrier(sync_on=None) -> None:
+    """Block until all devices (and processes) reach this point. Pass the
+    arrays whose pending computations must drain as ``sync_on`` for a
+    data-dependent guarantee (see `sync`)."""
     check_initialized()
+    if sync_on is not None:
+        sync(sync_on)
     _device_barrier()
 
 
-def tic() -> None:
+def tic(sync_on=None) -> None:
     """Start the chronometer once all devices have reached this point
     (reference `tools.jl:234`)."""
     global _t0
     check_initialized()
+    if sync_on is not None:
+        sync(sync_on)
     _device_barrier()
     _t0 = time.time()
 
 
-def toc() -> float:
+def toc(sync_on=None) -> float:
     """Elapsed seconds since `tic` once all devices have reached this point
-    (reference `tools.jl:235`)."""
+    (reference `tools.jl:235`). Pass the arrays produced by the timed region
+    as ``sync_on`` to guarantee their computations are included (data-
+    dependent drain; framework runners like ``run_chunked`` already sync)."""
     check_initialized()
+    if sync_on is not None:
+        sync(sync_on)
     _device_barrier()
     return time.time() - _t0
 
