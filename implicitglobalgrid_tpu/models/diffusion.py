@@ -106,12 +106,24 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
     "pallas_interpret" for CPU testing). 3-D only for pallas.
     """
     if impl.startswith("pallas") and T.ndim == 3:
-        from ..ops.pallas_stencil import diffusion3d_step_pallas
-
-        T = diffusion3d_step_pallas(
-            T, Cp, lam=p.lam, dt=p.dt, dx=p.dx, dy=p.dy, dz=p.dz,
-            interpret=(impl == "pallas_interpret"),
+        from ..ops.pallas_stencil import (
+            diffusion3d_step_halo_pallas, diffusion3d_step_pallas,
+            fusable_halo_dims,
         )
+
+        gg = global_grid()
+        kw = dict(lam=p.lam, dt=p.dt, dx=p.dx, dy=p.dy, dz=p.dz,
+                  interpret=(impl == "pallas_interpret"))
+        fuse = fusable_halo_dims(gg)
+        if fuse is not None:
+            # Self-neighbor halo updates folded into the step's output pass
+            # (free); any remaining dims exchange afterwards, preserving the
+            # z, x, y sequencing (fusable_halo_dims guarantees fused dims
+            # form a prefix of that order).
+            T = diffusion3d_step_halo_pallas(T, Cp, fuse=fuse, **kw)
+            rest = [d for d in (2, 0, 1) if not fuse[d]]
+            return local_update_halo(T, dims=rest) if rest else T
+        T = diffusion3d_step_pallas(T, Cp, **kw)
     elif T.ndim == 3:
         qx = -p.lam * d_xi(T) / p.dx
         qy = -p.lam * d_yi(T) / p.dy

@@ -27,7 +27,8 @@ from __future__ import annotations
 
 from functools import partial
 
-__all__ = ["diffusion3d_step_pallas", "pallas_supported"]
+__all__ = ["diffusion3d_step_pallas", "diffusion3d_step_halo_pallas",
+           "pallas_supported", "fusable_halo_dims"]
 
 
 def pallas_supported(T) -> bool:
@@ -35,77 +36,130 @@ def pallas_supported(T) -> bool:
     return T.ndim == 3 and T.shape[0] >= 3
 
 
-def _plane_kernel(Tm_ref, Tc_ref, Tp_ref, Cp_ref, out_ref, *,
-                  lam, dt, dx, dy, dz):
-    """Compute one x-plane of the updated temperature.
+def fusable_halo_dims(gg, ndim: int = 3):
+    """Which dims' halo exchange can fuse into the step kernel output pass.
 
-    Inputs are (1, ny, nz) planes: x-1, x, x+1 of T and x of Cp. Boundary
-    planes (first/last x, and y/z edges) keep their input values — the
-    reference stencil updates the interior only
-    (`diffusion3D_multicpu_novis.jl:47` writes `T[2:end-1,2:end-1,2:end-1]`).
+    A dim is fusable when it takes the reference's self-neighbor local path
+    (periodic axis, single shard — `update_halo.jl:62-68`) with the default
+    overlap/halowidth (ol=2, hw=1), i.e. the halo write is a pure in-plane
+    copy. Fusion must respect the reference's strict dim sequencing
+    (z, x, y — `update_halo.jl:45`): a dim may fuse only if every dim
+    BEFORE it in the order either fuses too or exchanges nothing — otherwise
+    its send slabs would miss the earlier dims' received corners. Returns
+    (fuse_x, fuse_y, fuse_z) or None if nothing can fuse.
+    """
+    if ndim != 3:
+        return None
+    fuse = [False, False, False]
+    for dim in (2, 0, 1):  # DEFAULT_DIMS_ORDER
+        D = int(gg.dims[dim])
+        periodic = bool(gg.periods[dim])
+        if D == 1 and not periodic:
+            continue  # no exchange on this dim — doesn't block later fusion
+        if (D == 1 and periodic and int(gg.overlaps[dim]) == 2
+                and int(gg.halowidths[dim]) == 1 and int(gg.disp) == 1):
+            fuse[dim] = True
+        else:
+            break  # multi-shard (or nonstandard) exchange: later dims can't fuse
+    if not any(fuse):
+        return None
+    return tuple(fuse)
+
+
+def _plane_halo_kernel(Tm_ref, Tc_ref, Tp_ref, Cp_ref, out_ref, *,
+                       lam, dt, dx, dy, dz, nx, fuse):
+    """One output x-plane of the fused step + self-neighbor halo update.
+
+    Inputs are (1, ny, nz) planes: source plane and its two x-neighbors of T
+    plus Cp. The flux arithmetic is in the EXACT accumulation order of the
+    reference example (`-d_xa(qx)/dx - d_ya(qy)/dy - d_za(qz)/dz`, then
+    `/Cp`, then `T + dt*dTdt` — `diffusion3D_multicpu_novis.jl:42-47`) so
+    results match the XLA flux-form step to the last ulp or two. Boundary
+    planes/rows/lanes keep their input values (the reference updates the
+    interior only), then come the halo writes of the reference's
+    self-neighbor local path (`update_halo.jl:62-68`) folded into the same
+    output pass, in the reference's exact dim order z, x, y
+    (`update_halo.jl:29,45`):
+
+    - z/y halos are in-plane copies (lane/row selects on the computed plane);
+    - the x halo re-sources output plane 0 from updated plane nx-2 and plane
+      nx-1 from updated plane 1 (``sigma`` in the BlockSpec index maps), so
+      the halo planes are recomputed rather than staged — two extra
+      plane-triple reads total, no extra array pass.
+
+    Corner semantics match the reference because the z edits are applied to
+    the computed plane BEFORE it is used as an x/y halo source, exactly like
+    the sequential exchange.
     """
     import jax.numpy as jnp
     from jax import lax
     from jax.experimental import pallas as pl
 
+    fuse_x, fuse_y, fuse_z = fuse
     i = pl.program_id(0)
-    n = pl.num_programs(0)
     tm = Tm_ref[0]
     tc = Tc_ref[0]
     tp = Tp_ref[0]
     cp = Cp_ref[0]
     ny, nz = tc.shape
 
-    # Flux form in the EXACT arithmetic/accumulation order of the reference
-    # example (`-d_xa(qx)/dx - d_ya(qy)/dy - d_za(qz)/dz`, then `/Cp`, then
-    # `T + dt*dTdt`) so results are bitwise identical to the XLA flux-form
-    # step for the same dtype.
     qxr = -lam * (tp - tc) / dx
     qxl = -lam * (tc - tm) / dx
-    acc = -((qxr - qxl) / dx)                     # (ny, nz)
-
-    qy = -lam * (tc[1:, :] - tc[:-1, :]) / dy     # (ny-1, nz)
-    div_y = (qy[1:, :] - qy[:-1, :]) / dy         # (ny-2, nz)
-    acc = acc - jnp.pad(div_y, ((1, 1), (0, 0)))
-
-    qz = -lam * (tc[:, 1:] - tc[:, :-1]) / dz     # (ny, nz-1)
-    div_z = (qz[:, 1:] - qz[:, :-1]) / dz         # (ny, nz-2)
-    acc = acc - jnp.pad(div_z, ((0, 0), (1, 1)))
-
+    acc = -((qxr - qxl) / dx)
+    qy = -lam * (tc[1:, :] - tc[:-1, :]) / dy
+    acc = acc - jnp.pad((qy[1:, :] - qy[:-1, :]) / dy, ((1, 1), (0, 0)))
+    qz = -lam * (tc[:, 1:] - tc[:, :-1]) / dz
+    acc = acc - jnp.pad((qz[:, 1:] - qz[:, :-1]) / dz, ((0, 0), (1, 1)))
     upd = tc + dt * (acc / cp)
 
     row = lax.broadcasted_iota(jnp.int32, (ny, nz), 0)
     col = lax.broadcasted_iota(jnp.int32, (ny, nz), 1)
+    sp = _sigma(i, nx) if fuse_x else i
     interior_yz = (row > 0) & (row < ny - 1) & (col > 0) & (col < nz - 1)
-    interior_x = (i > 0) & (i < n - 1)
-    out_ref[0] = jnp.where(interior_yz & interior_x, upd, tc)
+    u = jnp.where(interior_yz & (sp > 0) & (sp < nx - 1), upd, tc)
+    if fuse_z:  # halo lanes <- own interior lanes (broadcast column selects)
+        u = jnp.where(col == 0, u[:, nz - 2:nz - 1], u)
+        u = jnp.where(col == nz - 1, u[:, 1:2], u)
+    if fuse_y:  # after z (and x via sigma), like the sequential exchange
+        u = jnp.where(row == 0, u[ny - 2:ny - 1, :], u)
+        u = jnp.where(row == ny - 1, u[1:2, :], u)
+    out_ref[0] = u
 
 
-def diffusion3d_step_pallas(T, Cp, *, lam, dt, dx, dy, dz, interpret=False):
-    """One fused diffusion step on a LOCAL 3-D block (no halo exchange —
-    compose with `local_update_halo`). Grid over x-planes; each program
-    streams 3 T-planes + 1 Cp-plane through VMEM and writes 1 plane."""
+def _sigma(i, nx):
+    """Source plane of output plane ``i`` under the fused x halo update."""
+    import jax.numpy as jnp
+
+    return jnp.where(i == 0, nx - 2, jnp.where(i == nx - 1, 1, i))
+
+
+def diffusion3d_step_halo_pallas(T, Cp, *, lam, dt, dx, dy, dz, fuse,
+                                 interpret=False):
+    """Fused diffusion step + self-neighbor halo exchange on a LOCAL 3-D
+    block. ``fuse`` = (fuse_x, fuse_y, fuse_z) from `fusable_halo_dims`;
+    non-fused dims behave exactly like `diffusion3d_step_pallas` (exchange
+    them afterwards with `local_update_halo`)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     nx, ny, nz = T.shape
     plane = (1, ny, nz)
-
-    # Physics constants are baked into the kernel as compile-time Python
-    # floats (pallas forbids captured traced values), cast to the block dtype
-    # at trace time inside the kernel.
+    fuse_x = bool(fuse[0])
     dtp = T.dtype.type
     kernel = partial(
-        _plane_kernel,
+        _plane_halo_kernel,
         lam=dtp(lam), dt=dtp(dt), dx=dtp(dx), dy=dtp(dy), dz=dtp(dz),
+        nx=nx, fuse=tuple(bool(f) for f in fuse),
     )
 
-    def clamp(f):
-        return lambda i: (jnp.clip(f(i), 0, nx - 1), 0, 0)
+    def src(off):
+        def index_map(i):
+            s = _sigma(i, nx) if fuse_x else i
+            return (jnp.clip(s + off, 0, nx - 1), 0, 0)
+        return index_map
 
-    try:  # inside shard_map, outputs must declare their mesh-axis variance
+    try:
         out_shape = jax.ShapeDtypeStruct(T.shape, T.dtype, vma=jax.typeof(T).vma)
     except (AttributeError, TypeError):
         out_shape = jax.ShapeDtypeStruct(T.shape, T.dtype)
@@ -114,12 +168,23 @@ def diffusion3d_step_pallas(T, Cp, *, lam, dt, dx, dy, dz, interpret=False):
         kernel,
         grid=(nx,),
         in_specs=[
-            pl.BlockSpec(plane, clamp(lambda i: i - 1)),
-            pl.BlockSpec(plane, clamp(lambda i: i)),
-            pl.BlockSpec(plane, clamp(lambda i: i + 1)),
-            pl.BlockSpec(plane, clamp(lambda i: i)),
+            pl.BlockSpec(plane, src(-1)),
+            pl.BlockSpec(plane, src(0)),
+            pl.BlockSpec(plane, src(+1)),
+            pl.BlockSpec(plane, src(0)),
         ],
         out_specs=pl.BlockSpec(plane, lambda i: (i, 0, 0)),
         out_shape=out_shape,
         interpret=interpret,
     )(T, T, T, Cp)
+
+
+def diffusion3d_step_pallas(T, Cp, *, lam, dt, dx, dy, dz, interpret=False):
+    """One fused diffusion step on a LOCAL 3-D block (no halo exchange —
+    compose with `local_update_halo`). The ``fuse=(False, False, False)``
+    specialization of `diffusion3d_step_halo_pallas` — one shared kernel so
+    the ulp-sensitive accumulation order cannot diverge between the paths."""
+    return diffusion3d_step_halo_pallas(
+        T, Cp, lam=lam, dt=dt, dx=dx, dy=dy, dz=dz,
+        fuse=(False, False, False), interpret=interpret,
+    )

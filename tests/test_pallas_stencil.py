@@ -39,6 +39,43 @@ def test_pallas_f64():
     assert np.allclose(a, b, rtol=1e-13, atol=1e-12)
 
 
+@pytest.mark.parametrize("dims,periods,expected_fuse", [
+    ((1, 1, 1), (1, 1, 1), (True, True, True)),    # all self-neighbor
+    ((2, 1, 1), (1, 1, 1), (False, False, True)),  # z fuses; x multi-shard blocks y
+    ((1, 1, 2), (1, 1, 1), None),                  # z multi-shard blocks everything
+    ((1, 1, 1), (0, 0, 0), None),                  # nothing exchanges
+    ((1, 2, 1), (1, 0, 1), (True, False, True)),   # z,x fuse; y (multi-shard) breaks
+    ((1, 1, 1), (1, 1, 0), (True, True, False)),   # z exchanges nothing -> x,y still fuse
+])
+def test_fusable_halo_dims(dims, periods, expected_fuse):
+    """Fusion must cover only a prefix of the z, x, y exchange order
+    (reference `update_halo.jl:45` sequencing — corners propagate dim by
+    dim)."""
+    from implicitglobalgrid_tpu.ops.pallas_stencil import fusable_halo_dims
+
+    igg.init_global_grid(8, 8, 8, dimx=dims[0], dimy=dims[1], dimz=dims[2],
+                         periodx=periods[0], periody=periods[1],
+                         periodz=periods[2], quiet=True)
+    assert fusable_halo_dims(igg.global_grid()) == expected_fuse
+
+
+@pytest.mark.parametrize("dims,periods", [
+    ((1, 1, 1), (1, 1, 1)),  # all dims fused in-kernel
+    ((2, 1, 1), (1, 1, 1)),  # mixed: fused z + ppermute x + local y
+    ((1, 1, 1), (0, 0, 0)),  # no exchange at all
+])
+def test_pallas_fused_halo_matches_xla(dims, periods):
+    """The fused step+halo kernel must reproduce the XLA step followed by the
+    sequential exchange — including corner propagation through the dims."""
+    igg.init_global_grid(16, 16, 16, dimx=dims[0], dimy=dims[1], dimz=dims[2],
+                         periodx=periods[0], periody=periods[1],
+                         periodz=periods[2], quiet=True)
+    T, Cp, p = init_diffusion3d(dtype=np.float32)
+    a = np.asarray(igg.gather(make_run(p, 10, impl="xla")(T, Cp)[0]))
+    b = np.asarray(igg.gather(make_run(p, 10, impl="pallas_interpret")(T, Cp)[0]))
+    assert np.allclose(a, b, rtol=1e-5, atol=1e-4)
+
+
 def test_impl_resolution_from_env_flag():
     from implicitglobalgrid_tpu.models.diffusion import _resolve_impl
 
