@@ -31,8 +31,14 @@ def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
 
     check_initialized()
     gg = global_grid()
+    if gg.device_type == "tpu" and bool(gg.use_pallas.any()):
+        # Pallas kernels (step and halo-write) may appear anywhere in the
+        # step when the Pallas tier is enabled and cannot express mesh-axis
+        # variance — vma checking stays on for pure-XLA configurations.
+        check_vma = False
     if key is not None:
-        full_key = (gg.epoch, key, tuple(state_ndims), int(nt_chunk))
+        full_key = (gg.epoch, key, tuple(state_ndims), int(nt_chunk),
+                    bool(check_vma))
         fn = _runner_cache.get(full_key)
         if fn is not None:
             return fn

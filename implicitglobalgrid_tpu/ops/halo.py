@@ -86,11 +86,96 @@ def _dim_meta(gg, dim: int):
     return D, periodic, disp
 
 
-def _exchange_dim_local(a, *, dim, hw, ol_d, D, periodic, disp, axis_name):
+# Test hook: force the in-place Pallas halo-write kernels in interpret mode
+# (CPU) so the kernel path is exercised by the emulated-mesh test suite.
+_FORCE_PALLAS_WRITE_INTERPRET = False
+
+
+def _pallas_write_mode(gg, dim, shape, hw):
+    """(use_kernel, interpret) for the halo unpack along ``dim``."""
+    from .pallas_halo import halo_write_supported
+
+    if not halo_write_supported(shape, dim, hw):
+        return False, False
+    if _FORCE_PALLAS_WRITE_INTERPRET:
+        return True, True
+    return bool(gg.use_pallas[dim]) and gg.device_type == "tpu", False
+
+
+def _self_exchange_plan(gg, shape, hws, dims_order):
+    """If every participating dim of a field with this local ``shape`` takes
+    the self-neighbor path, return (modes, ols) for the single-pass kernel
+    (`pallas_halo.halo_self_exchange_pallas`); else None.
+
+    Only valid when ALL exchanging dims are self-neighbor: a mix would break
+    the reference's strict dim sequencing (a later self dim must see an
+    earlier ppermute dim's received corners). The kernel hardwires the
+    default z, x, y order.
+    """
+    from .pallas_halo import self_exchange_supported
+
+    if tuple(dims_order) != DEFAULT_DIMS_ORDER or len(shape) != 3:
+        return None
+    if not (_FORCE_PALLAS_WRITE_INTERPRET
+            or (bool(gg.use_pallas.all()) and gg.device_type == "tpu")):
+        return None
+    modes = [False, False, False]
+    ols = [0, 0, 0]
+    for dim in range(3):
+        D = int(gg.dims[dim])
+        periodic = bool(gg.periods[dim])
+        hw = int(hws[dim])
+        ol_d = int(gg.overlaps[dim] + (shape[dim] - gg.nxyz[dim]))
+        if D == 1 and not periodic:
+            continue                      # no exchange
+        if ol_d < 2 * hw:
+            continue                      # computation-overlap only
+        if D != 1 or not periodic or int(gg.disp) != 1:
+            return None                   # a ppermute dim: no single-pass
+        modes[dim] = True
+        ols[dim] = ol_d
+    if not self_exchange_supported(shape, modes, hws):
+        return None
+    return tuple(modes), tuple(ols)
+
+
+def _dim_exchanges(gg, shape, hws, dim) -> bool:
+    """Whether a field of this local ``shape`` exchanges along ``dim`` (the
+    participation gates of the per-dim loop)."""
+    if dim >= len(shape):
+        return False
+    D, periodic, _ = _dim_meta(gg, dim)
+    if D == 1 and not periodic:
+        return False
+    ol_d = int(gg.overlaps[dim] + (shape[dim] - gg.nxyz[dim]))
+    return ol_d >= 2 * int(hws[dim])
+
+
+def _apply_self_exchange(gg, arrays, hws, dims_order):
+    """Run the single-pass self-neighbor kernel on every eligible field.
+    Mutates ``arrays``; returns ``handled`` flags (True = fully exchanged)."""
+    handled = [False] * len(arrays)
+    for i, a in enumerate(arrays):
+        plan = _self_exchange_plan(gg, a.shape, hws[i], dims_order)
+        if plan is not None:
+            from .pallas_halo import halo_self_exchange_pallas
+
+            arrays[i] = halo_self_exchange_pallas(
+                a, modes=plan[0], ols=plan[1],
+                interpret=_FORCE_PALLAS_WRITE_INTERPRET,
+            )
+            handled[i] = True
+    return handled
+
+
+def _exchange_dim_local(a, *, dim, hw, ol_d, D, periodic, disp, axis_name,
+                        pallas_write=False, interpret=False):
     """Exchange the halos of local block ``a`` along array axis ``dim``.
 
     Runs inside `shard_map`. All shapes/indices are static; only the mesh
-    coordinate (`axis_index`) is traced.
+    coordinate (`axis_index`) is traced. With ``pallas_write``, the unpack
+    writes the halo slabs in place via the Pallas kernels (`pallas_halo.py`)
+    instead of full-array `dynamic_update_slice` rewrites.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -101,6 +186,18 @@ def _exchange_dim_local(a, *, dim, hw, ol_d, D, periodic, disp, axis_name):
             f"Field of local size {s} along dimension {dim} cannot hold send slabs "
             f"(overlap {ol_d}, halowidth {hw})."
         )
+
+    def write_halos(a, into_l, into_r):
+        """Halo writes: left halo <- ``into_l``, right halo <- ``into_r``."""
+        if pallas_write:
+            from .pallas_halo import halo_write_inplace
+
+            return halo_write_inplace(a, into_l, into_r, dim=dim, hw=hw,
+                                      interpret=interpret)
+        a = lax.dynamic_update_slice_in_dim(a, into_l, 0, axis=dim)
+        a = lax.dynamic_update_slice_in_dim(a, into_r, s - hw, axis=dim)
+        return a
+
     # Send slabs (reference sendranges, update_halo.jl:275-284).
     send_r = lax.slice_in_dim(a, s - ol_d, s - ol_d + hw, axis=dim)   # n=2
     send_l = lax.slice_in_dim(a, ol_d - hw, ol_d, axis=dim)           # n=1
@@ -109,10 +206,9 @@ def _exchange_dim_local(a, *, dim, hw, ol_d, D, periodic, disp, axis_name):
         if not periodic:
             return a
         # Self-neighbor: periodic axis with one shard — pure local copies
-        # (reference sendrecv_halo_local, update_halo.jl:363-380).
-        a = lax.dynamic_update_slice_in_dim(a, send_r, 0, axis=dim)       # left halo ← own right slab
-        a = lax.dynamic_update_slice_in_dim(a, send_l, s - hw, axis=dim)  # right halo ← own left slab
-        return a
+        # (reference sendrecv_halo_local, update_halo.jl:363-380):
+        # left halo <- own right slab, right halo <- own left slab.
+        return write_halos(a, send_r, send_l)
 
     if periodic:
         perm_p = [(i, (i + disp) % D) for i in range(D)]
@@ -130,17 +226,12 @@ def _exchange_dim_local(a, *, dim, hw, ol_d, D, periodic, disp, axis_name):
     recv_r = lax.ppermute(send_l, axis_name, perm_m) if perm_m else None  # from coord+disp
 
     idx = lax.axis_index(axis_name)
-    if recv_l is not None:
-        if not periodic:
-            cur_l = lax.slice_in_dim(a, 0, hw, axis=dim)
-            recv_l = jnp.where(idx >= disp, recv_l, cur_l)  # PROC_NULL edge: keep halo
-        a = lax.dynamic_update_slice_in_dim(a, recv_l, 0, axis=dim)
-    if recv_r is not None:
-        if not periodic:
-            cur_r = lax.slice_in_dim(a, s - hw, s, axis=dim)
-            recv_r = jnp.where(idx < D - disp, recv_r, cur_r)
-        a = lax.dynamic_update_slice_in_dim(a, recv_r, s - hw, axis=dim)
-    return a
+    if not periodic:  # PROC_NULL edges: boundary shards keep their halos
+        cur_l = lax.slice_in_dim(a, 0, hw, axis=dim)
+        recv_l = jnp.where(idx >= disp, recv_l, cur_l)
+        cur_r = lax.slice_in_dim(a, s - hw, s, axis=dim)
+        recv_r = jnp.where(idx < D - disp, recv_r, cur_r)
+    return write_halos(a, recv_l, recv_r)
 
 
 def local_update_halo(*fields, dims=None):
@@ -161,11 +252,16 @@ def local_update_halo(*fields, dims=None):
     dims_order = _normalize_dims_order(dims)
     fs = [wrap_field(f) for f in fields]
     arrays = [f.A for f in fs]
+    # Fields whose every exchanging dim is self-neighbor: one kernel pass.
+    handled = _apply_self_exchange(gg, arrays, [f.halowidths for f in fs],
+                                   dims_order)
     for dim in dims_order:
         D, periodic, disp = _dim_meta(gg, dim)
         if D == 1 and not periodic:
             continue  # no neighbors along this axis (reference update_halo.jl:45 note)
         for i, f in enumerate(fs):
+            if handled[i]:
+                continue
             a = arrays[i]
             if dim >= a.ndim:
                 continue
@@ -173,9 +269,11 @@ def local_update_halo(*fields, dims=None):
             ol_d = int(gg.overlaps[dim] + (a.shape[dim] - gg.nxyz[dim]))
             if ol_d < 2 * hw:
                 continue  # computation overlap only, no halo (update_halo.jl:233)
+            pw, interp = _pallas_write_mode(gg, dim, a.shape, hw)
             arrays[i] = _exchange_dim_local(
                 a, dim=dim, hw=hw, ol_d=ol_d, D=D, periodic=periodic,
                 disp=disp, axis_name=AXIS_NAMES[dim],
+                pallas_write=pw, interpret=interp,
             )
     return arrays[0] if len(arrays) == 1 else tuple(arrays)
 
@@ -188,27 +286,44 @@ def _build_exchange_fn(gg, sig, dims_order):
     in_specs = tuple(field_partition_spec(nd) for nd in ndims_arr)
     hws = [hw for (_, _, hw) in sig]
 
+    # Pallas kernels under shard_map require check_vma=False (their outputs
+    # can't express the mesh-axis variance the checker wants — same rule as
+    # the model step kernels, models/diffusion.py).
+    any_pallas = any(
+        _self_exchange_plan(gg, shape, hw, dims_order) is not None
+        or any(
+            _dim_exchanges(gg, shape, hw, dim)
+            and _pallas_write_mode(gg, dim, shape, int(hw[dim]))[0]
+            for dim in dims_order
+        )
+        for (shape, _, hw) in sig
+    )
+
     def exchange(*locals_):
         arrays = list(locals_)
+        handled = _apply_self_exchange(gg, arrays, hws, dims_order)
         for dim in dims_order:
             D, periodic, disp = _dim_meta(gg, dim)
             if D == 1 and not periodic:
                 continue
             for i, a in enumerate(arrays):
-                if dim >= a.ndim:
+                if handled[i] or dim >= a.ndim:
                     continue
                 hw = int(hws[i][dim])
                 ol_d = int(gg.overlaps[dim] + (a.shape[dim] - gg.nxyz[dim]))
                 if ol_d < 2 * hw:
                     continue
+                pw, interp = _pallas_write_mode(gg, dim, a.shape, hw)
                 arrays[i] = _exchange_dim_local(
                     a, dim=dim, hw=hw, ol_d=ol_d, D=D, periodic=periodic,
                     disp=disp, axis_name=AXIS_NAMES[dim],
+                    pallas_write=pw, interpret=interp,
                 )
         return tuple(arrays)
 
     shmapped = jax.shard_map(
-        exchange, mesh=gg.mesh, in_specs=in_specs, out_specs=in_specs
+        exchange, mesh=gg.mesh, in_specs=in_specs, out_specs=in_specs,
+        check_vma=not any_pallas,
     )
     return jax.jit(shmapped)
 
@@ -277,7 +392,7 @@ def update_halo(*fields, dims=None):
         )
         for a, f in zip(arrays, fs)
     )
-    key = (grid_epoch(), sig, dims_order)
+    key = (grid_epoch(), sig, dims_order, _FORCE_PALLAS_WRITE_INTERPRET)
     fn = _exchange_cache.get(key)
     if fn is None:
         fn = _build_exchange_fn(gg, sig, dims_order)

@@ -1,0 +1,245 @@
+"""In-place Pallas halo-write kernels — the unpack stage of the exchange.
+
+The reference's GPU extension hand-writes pack/unpack kernels
+(`write_d2x!`/`read_x2d!`, `/root/reference/src/CUDAExt/update_halo.jl:210-227`)
+so halo traffic is slab-sized instead of array-sized. The XLA analog of the
+unpack — `dynamic_update_slice` on the full block — makes the compiler
+rewrite the whole array per updated side (several full HBM round trips per
+exchange). These kernels restore slab-sized traffic: a ``pallas_call`` with
+``input_output_aliases`` updates the halo regions IN PLACE and never touches
+the rest of the buffer.
+
+Per-dimension strategy (TPU tiling constraints — (8, 128) sublane x lane
+tiles on f32 — forbid misaligned writes along the last two axes):
+
+- dim 0 (x): halo planes are whole (ny, nz) tiles — write them directly from
+  the received slabs; nothing else is read or written.
+- dim 1 (y): read-modify-write the first/last 8-row-aligned strip of every
+  x-plane; traffic = 2*ceil(hw/8)*8 rows per plane.
+- dim 2 (z): NO kernel — its halo tiles are 128-lane strips whose rows are
+  128-element chunks strided by the full row pitch (~25% DMA efficiency);
+  measured slower than XLA's contiguous full-array `dynamic_update_slice`
+  rewrite, which stays the dim-2 unpack path.
+
+Additionally, when EVERY exchanging dim is the self-neighbor case,
+`halo_self_exchange_pallas` does the whole exchange in one full array pass
+with no slab extraction at all (see below).
+
+`halo_write_supported` gates on the alignment preconditions; callers fall
+back to the XLA `dynamic_update_slice` path when it returns False (non-TPU
+platforms, dim 2, tiny blocks, exotic halowidths).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+__all__ = ["halo_write_supported", "halo_write_inplace",
+           "self_exchange_supported", "halo_self_exchange_pallas"]
+
+_SUBLANE = 8
+_LANE = 128
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def halo_write_supported(shape, dim: int, hw: int) -> bool:
+    """Whether the in-place kernel can write halo slabs of width ``hw`` along
+    ``dim`` for a local block of this shape (3-D only).
+
+    dim 2 is deliberately unsupported: its halo tiles are 128-lane strips
+    whose rows are 128-element chunks strided by the full row pitch, so the
+    strip RMW runs at ~25% DMA efficiency — measured SLOWER than letting XLA
+    rewrite the array contiguously (dynamic_update_slice fusion). dims 0/1
+    write contiguous planes / 8-row strips and win.
+    """
+    if len(shape) != 3 or dim == 2:
+        return False
+    s = int(shape[dim])
+    if dim == 0:
+        return s >= 2 * hw
+    strip = _ceil_to(hw, _SUBLANE)
+    # top and bottom strips must be disjoint and block-aligned
+    return s >= 2 * strip and s % strip == 0
+
+
+def halo_write_inplace(a, slab_l, slab_r, *, dim: int, hw: int,
+                       interpret: bool = False):
+    """Return ``a`` with ``slab_l`` written into its ``[0, hw)`` halo and
+    ``slab_r`` into its ``[s-hw, s)`` halo along ``dim`` — in place (the
+    output aliases ``a``'s buffer; only the halo tiles move through VMEM).
+
+    ``slab_l``/``slab_r`` have ``hw`` extent along ``dim``; the slabs must
+    not alias the written regions (guaranteed by the exchange's ``ol >= 2*hw``
+    participation gate, reference `update_halo.jl:233`).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    nx, ny, nz = a.shape
+    s = a.shape[dim]
+
+    try:  # inside shard_map, outputs must declare their mesh-axis variance
+        vma = jax.typeof(a).vma | jax.typeof(slab_l).vma | jax.typeof(slab_r).vma
+        out_shape = jax.ShapeDtypeStruct(a.shape, a.dtype, vma=vma)
+    except (AttributeError, TypeError):
+        out_shape = jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+    if dim == 0:
+        # Halo planes are whole tiles: write them straight from the slabs.
+        slabs = jnp.concatenate([slab_l, slab_r], axis=0)     # (2hw, ny, nz)
+        plane = (1, ny, nz)
+
+        def kernel(s_ref, a_ref, o_ref):
+            o_ref[...] = s_ref[...]
+
+        return pl.pallas_call(
+            kernel,
+            grid=(2 * hw,),
+            in_specs=[
+                pl.BlockSpec(plane, lambda i: (i, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),      # aliased, untouched
+            ],
+            out_specs=pl.BlockSpec(
+                plane, lambda i: (jnp.where(i < hw, i, s - 2 * hw + i), 0, 0)
+            ),
+            out_shape=out_shape,
+            input_output_aliases={1: 0},
+            interpret=interpret,
+        )(slabs, a)
+
+    # dim 1: RMW the minimal 8-row-aligned edge strip of every x-plane.
+    strip = _ceil_to(hw, _SUBLANE)
+    pad = strip - hw
+    # slabs (nx, hw, nz) -> (2, nx, strip, nz); left slab occupies rows
+    # [0, hw), right slab rows [strip-hw, strip) of its strip.
+    slabs = jnp.stack([
+        jnp.pad(slab_l, ((0, 0), (0, pad), (0, 0))),
+        jnp.pad(slab_r, ((0, 0), (pad, 0), (0, 0))),
+    ])
+    blk_a = (1, strip, nz)
+    n_blocks = ny // strip
+    blk_s = (1,) + blk_a
+
+    kernel = partial(_rmw_kernel, dim=dim, hw=hw, strip=strip)
+
+    def a_map(i, j):
+        return (i, j * (n_blocks - 1), 0)          # j=0: first, j=1: last
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nx, 2),
+        in_specs=[
+            pl.BlockSpec(blk_s, lambda i, j: (j, i, 0, 0)),
+            pl.BlockSpec(blk_a, a_map),
+        ],
+        out_specs=pl.BlockSpec(blk_a, a_map),
+        out_shape=out_shape,
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(slabs, a)
+
+
+def _rmw_kernel(s_ref, a_ref, o_ref, *, dim, hw, strip):
+    """Merge the slab into the aligned edge strip: side j=0 overwrites the
+    first ``hw`` rows/lanes, side j=1 the last ``hw`` of the strip."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    del dim  # only dim 1 reaches the RMW kernel (halo_write_supported)
+    j = pl.program_id(1)
+    cur = a_ref[0]
+    sl = s_ref[0, 0]
+    pos = lax.broadcasted_iota(jnp.int32, cur.shape, 0)
+    left = j == 0  # scalar-predicate select over bool vectors won't legalize
+    mask = (left & (pos < hw)) | (~left & (pos >= strip - hw))
+    o_ref[0] = jnp.where(mask, sl, cur)
+
+
+# ---------------------------------------------------------------------------
+# Single-pass self-neighbor exchange: the reference's 1-process periodic path
+# (`update_halo.jl:62-68,363-380`) for ALL dims in ONE array pass.
+# ---------------------------------------------------------------------------
+
+def self_exchange_supported(shape, modes, hws) -> bool:
+    """Whether `halo_self_exchange_pallas` can run: 3-D block, every
+    participating dim in self-neighbor mode with halowidth 1 (wider halos
+    need in-register lane/row shifts that don't pay off), at least one
+    participating dim, and >= 3 planes when dim 0 participates."""
+    if len(shape) != 3 or not any(modes):
+        return False
+    for d in range(3):
+        if modes[d] and int(hws[d]) != 1:
+            return False
+    if modes[0] and int(shape[0]) < 3:
+        return False
+    return True
+
+
+def halo_self_exchange_pallas(a, *, modes, ols, interpret=False):
+    """Exchange all self-neighbor halos of local block ``a`` in ONE pass.
+
+    ``modes[d]`` = True when dim ``d`` is a periodic single-shard axis (the
+    reference's self-neighbor path); ``ols[d]`` = its overlap. Halowidth 1.
+    Equivalent to the sequential z, x, y slab copies of
+    `ops.halo._exchange_dim_local` but costs a single full read+write of the
+    block instead of one array rewrite per side — and no slab extraction at
+    all: z/y halos are in-plane broadcast selects, x halo planes are sourced
+    directly from their interior source plane via the BlockSpec index maps
+    (the corner-ordering argument is the same as the fused step kernel,
+    `pallas_stencil._plane_halo_kernel`).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    nx, ny, nz = a.shape
+    plane = (1, ny, nz)
+    modes = tuple(bool(m) for m in modes)
+    ols = tuple(int(o) for o in ols)
+
+    def sigma(i):
+        if not modes[0]:
+            return i
+        return jnp.where(i == 0, nx - ols[0],
+                         jnp.where(i == nx - 1, ols[0] - 1, i))
+
+    kernel = partial(_self_exchange_kernel, modes=modes, ols=ols)
+
+    try:
+        out_shape = jax.ShapeDtypeStruct(a.shape, a.dtype, vma=jax.typeof(a).vma)
+    except (AttributeError, TypeError):
+        out_shape = jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nx,),
+        in_specs=[pl.BlockSpec(plane, lambda i: (sigma(i), 0, 0))],
+        out_specs=pl.BlockSpec(plane, lambda i: (i, 0, 0)),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(a)
+
+
+def _self_exchange_kernel(a_ref, o_ref, *, modes, ols):
+    """Write one output plane: the sourced plane with its z (lane) and y
+    (row) halo edges replaced by their periodic in-plane sources, in the
+    reference's z, x, y order (x is realized by the plane sourcing)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    u = a_ref[0]
+    ny, nz = u.shape
+    if modes[2]:  # z halos first (reference dim order z, x, y)
+        col = lax.broadcasted_iota(jnp.int32, (ny, nz), 1)
+        u = jnp.where(col == 0, u[:, nz - ols[2]:nz - ols[2] + 1], u)
+        u = jnp.where(col == nz - 1, u[:, ols[2] - 1:ols[2]], u)
+    if modes[1]:  # y halos last, after the x plane sourcing
+        row = lax.broadcasted_iota(jnp.int32, (ny, nz), 0)
+        u = jnp.where(row == 0, u[ny - ols[1]:ny - ols[1] + 1, :], u)
+        u = jnp.where(row == ny - 1, u[ols[1] - 1:ols[1], :], u)
+    o_ref[0] = u
