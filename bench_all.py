@@ -1,0 +1,141 @@
+"""Benchmark suite: one JSON line per BASELINE.json config.
+
+Runs every workload family of `/root/repo/BASELINE.json` on the available
+devices (one real TPU chip, or the 8-device virtual CPU mesh with --cpu):
+
+- diffusion3D 256^3/chip, f32 and f64 (configs 1, 3; f64 is the reference's
+  anchor dtype — on v5e it runs through the f32 pipeline emulation)
+- 2-D diffusion, f32 (config 2)
+- 3-D acoustic wave with hide_communication overlap (config 4)
+- 3-D pseudo-transient Stokes (config 5)
+
+`bench.py` stays the single-headline-metric entry point (the driver runs
+it); this suite is for the full per-config record. Weak-scaling efficiency
+needs >1 chip — see bench_weak.py (virtual-mesh harness).
+
+Usage: python bench_all.py [--cpu]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _rate(cells, steps, t):
+    return cells * steps / t
+
+
+def main() -> None:
+    cpu = "--cpu" in sys.argv
+    if cpu:
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    if cpu:  # f64 anchor config needs x64; TPU has no native f64 pipeline
+        jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import (
+        init_acoustic3d, init_diffusion2d, init_diffusion3d, make_run,
+        run_acoustic, run_diffusion, run_stokes, init_stokes3d,
+    )
+
+    nd = len(jax.devices())
+    dims3 = tuple(int(d) for d in igg.dims_create(nd, (0, 0, 0)))
+    n_chips = int(np.prod(dims3))
+    results = []
+
+    def record(name, value, unit, baseline=None):
+        row = {"metric": name, "value": value, "unit": unit}
+        if baseline:
+            row["vs_baseline"] = value / baseline
+        results.append(row)
+        print(json.dumps(row))
+
+    def timed(run_fn, state, nt, chunk):
+        # warm both chunk programs, then time steady state
+        run_fn(state, min(chunk, nt), chunk)
+        igg.tic()
+        out = run_fn(state, nt, chunk)
+        return igg.toc(sync_on=out)
+
+    # --- diffusion3D f32 / f64 (BASELINE configs 1, 3) ---------------------
+    nx, nt = (48, 50) if cpu else (256, 1000)
+    dtypes = [(np.float32, "f32")]
+    if cpu:
+        dtypes.append((np.float64, "f64"))
+    else:
+        row = {
+            "metric": "diffusion3D_f64_cell_updates_per_s_per_chip",
+            "value": None, "unit": "cell-updates/s/chip",
+            "note": "no native f64 on this TPU generation; f64 semantics "
+                    "verified on the x64 CPU mesh (tests, bench_all --cpu)",
+        }
+        results.append(row)
+        print(json.dumps(row))
+    for dtype, tag in dtypes:
+        igg.init_global_grid(nx, nx, nx, dimx=dims3[0], dimy=dims3[1],
+                             dimz=dims3[2], periodx=1, periody=1, periodz=1,
+                             quiet=True)
+        T, Cp, p = init_diffusion3d(dtype=dtype)
+        t = timed(lambda s, n, c: run_diffusion(s[0], s[1], p, n, nt_chunk=c),
+                  (T, Cp), nt, max(1, nt // 10))
+        cells = float(igg.nx_g()) * float(igg.ny_g()) * float(igg.nz_g())
+        record(f"diffusion3D_{tag}_cell_updates_per_s_per_chip",
+               _rate(cells, nt, t) / n_chips, "cell-updates/s/chip",
+               baseline=0.95e9)  # reference: 0.95e9/GPU f64 (BASELINE.md)
+        igg.finalize_global_grid()
+
+    # --- diffusion2D f32 (BASELINE config 2: 2-D on a 2x2 mesh) ------------
+    dims2 = tuple(int(d) for d in igg.dims_create(nd, (0, 0, 1)))
+    nx2, nt2 = (64, 50) if cpu else (4096, 1000)
+    igg.init_global_grid(nx2, nx2, 1, dimx=dims2[0], dimy=dims2[1], dimz=1,
+                         periodx=1, periody=1, quiet=True)
+    T, Cp, p = init_diffusion2d(dtype=np.float32)
+    t = timed(lambda s, n, c: run_diffusion(s[0], s[1], p, n, nt_chunk=c),
+              (T, Cp), nt2, max(1, nt2 // 10))
+    record("diffusion2D_f32_cell_updates_per_s_per_chip",
+           _rate(float(igg.nx_g()) * float(igg.ny_g()), nt2, t) / n_chips,
+           "cell-updates/s/chip")
+    igg.finalize_global_grid()
+
+    # --- acoustic 3-D with hide_communication (BASELINE config 4) ----------
+    nxa, nta = (32, 30) if cpu else (192, 600)
+    igg.init_global_grid(nxa, nxa, nxa, dimx=dims3[0], dimy=dims3[1],
+                         dimz=dims3[2], periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    state, p = init_acoustic3d(dtype=np.float32, overlap=True)
+    t = timed(lambda s, n, c: run_acoustic(s, p, n, nt_chunk=c),
+              state, nta, max(1, nta // 10))
+    cells = float(igg.nx_g()) * float(igg.ny_g()) * float(igg.nz_g())
+    record("acoustic3D_overlap_f32_cell_updates_per_s_per_chip",
+           _rate(cells, nta, t) / n_chips, "cell-updates/s/chip")
+    igg.finalize_global_grid()
+
+    # --- pseudo-transient Stokes 3-D (BASELINE config 5) -------------------
+    nxs, nts = (24, 20) if cpu else (128, 300)
+    igg.init_global_grid(nxs, nxs, nxs, dimx=dims3[0], dimy=dims3[1],
+                         dimz=dims3[2], quiet=True)
+    state, p = init_stokes3d(dtype=np.float32)
+    t = timed(lambda s, n, c: run_stokes(s, p, n, nt_chunk=c),
+              state, nts, max(1, nts // 10))
+    cells = float(igg.nx_g()) * float(igg.ny_g()) * float(igg.nz_g())
+    record("stokes3D_pt_f32_cell_updates_per_s_per_chip",
+           _rate(cells, nts, t) / n_chips, "cell-updates/s/chip")
+    igg.finalize_global_grid()
+
+    with open("BENCH_ALL.json", "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

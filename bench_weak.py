@@ -1,0 +1,87 @@
+"""Weak-scaling efficiency harness (BASELINE.json north star:
+">=90% parallel efficiency at v5p-256 vs single chip").
+
+Weak scaling: the per-device local block stays fixed while the device count
+grows; efficiency = t(1 device) / t(N devices) for the same per-device work.
+The reference's headline claim is the near-flat weak-scaling curve on
+thousands of GPUs (`reference README.md:6-8`).
+
+With one real TPU chip this harness cannot measure true multi-chip scaling;
+it runs the SAME code path (per-axis ppermute exchange over the mesh) on the
+virtual CPU mesh to validate the harness end-to-end. Virtual CPU devices
+share host cores, so the printed efficiency UNDERSTATES real hardware — on a
+pod, point it at the real devices (no --cpu) and the number is the real one.
+
+Usage: python bench_weak.py --cpu [--devices N]   (virtual mesh harness)
+       python bench_weak.py                       (real devices, needs >1 chip)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> None:
+    cpu = "--cpu" in sys.argv
+    n_req = None
+    if "--devices" in sys.argv:
+        n_req = int(sys.argv[sys.argv.index("--devices") + 1])
+    if cpu:
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_req or 8}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import init_diffusion3d, run_diffusion
+
+    devices = jax.devices()
+    n = n_req or len(devices)
+    if n < 2:
+        print(json.dumps({
+            "metric": "weak_scaling_efficiency", "value": None,
+            "unit": "t1/tN",
+            "note": "needs >1 device; run with --cpu for the virtual-mesh harness",
+        }))
+        return
+
+    local_n, nt = (48, 60) if cpu else (256, 600)
+    chunk = max(1, nt // 6)
+
+    def measure(nd):
+        dims = tuple(int(d) for d in igg.dims_create(nd, (0, 0, 0)))
+        igg.init_global_grid(local_n, local_n, local_n,
+                             dimx=dims[0], dimy=dims[1], dimz=dims[2],
+                             periodx=1, periody=1, periodz=1,
+                             devices=devices[:nd], quiet=True)
+        T, Cp, p = init_diffusion3d(dtype=np.float32)
+        run_diffusion(T, Cp, p, chunk, nt_chunk=chunk)   # warm
+        igg.tic()
+        out = run_diffusion(T, Cp, p, nt, nt_chunk=chunk)
+        t = igg.toc(sync_on=out)
+        igg.finalize_global_grid()
+        return t
+
+    t1 = measure(1)
+    tn = measure(n)
+    eff = t1 / tn
+    print(json.dumps({
+        "metric": "weak_scaling_efficiency",
+        "value": eff,
+        "unit": f"t1/t{n}",
+        "vs_baseline": eff / 0.90,   # north star: >=0.90 at scale
+        "note": ("virtual CPU mesh (devices share host cores; understates "
+                 "real hardware)" if cpu else "real devices"),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -56,7 +56,9 @@ def init_acoustic3d(*, rho=1.0, K=1.0, lx=10.0, ly=10.0, lz=10.0,
     nx, ny, nz = (int(n) for n in gg.nxyz)
     dx, dy, dz = lx / (nx_g() - 1), ly / (ny_g() - 1), lz / (nz_g() - 1)
     c = float(np.sqrt(K / rho))
-    dt = min(dx, dy, dz) / c / np.sqrt(3.1)
+    # plain python float: a np.float64 scalar would promote f32 state arrays
+    # to f64 under jax_enable_x64
+    dt = float(min(dx, dy, dz) / c / np.sqrt(3.1))
 
     Pz = zeros_g((nx, ny, nz), dtype=dtype)
     x, y, z = coords_g(dx, dy, dz, Pz)

@@ -193,10 +193,14 @@ def check_fields(fields) -> None:
             "supported array type."
         )
 
-    # dtype must be a numeric/bool dtype (analog of the isbits check :441-447)
+    # dtype must be a numeric/bool dtype (analog of the isbits check :441-447).
+    # Extended TPU float dtypes (bfloat16, fp8 — ml_dtypes extension types with
+    # numpy kind 'V') are numbers too; classify via jnp.issubdtype.
+    import jax.numpy as jnp
+
     for i, f in enumerate(fields):
         dt = np.dtype(getattr(f.A, "dtype", None) or np.asarray(f.A).dtype)
-        if dt.kind not in "biufc":
+        if dt.kind not in "biufc" and not jnp.issubdtype(dt, jnp.number):
             raise InvalidArgumentError(
                 f"The field at position {i + 1} has unsupported element type {dt}."
             )

@@ -38,6 +38,16 @@ def test_acoustic_overlap_matches_plain():
         assert np.array_equal(x, y)
 
 
+def test_acoustic_f32_stays_f32_under_x64():
+    """Params must be weak python floats: a np.float64 scalar would promote
+    f32 state to f64 under jax_enable_x64 (regression: hide_communication
+    dtype mismatch)."""
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2, quiet=True)
+    state, p = init_acoustic3d(dtype=np.float32, overlap=True)
+    out = run_acoustic(state, p, 4, nt_chunk=2)
+    assert all(a.dtype == np.float32 for a in out)
+
+
 def test_acoustic_wave_propagates():
     P0 = _acoustic(8, (2, 2, 2), nt=0)[0]
     P1 = _acoustic(8, (2, 2, 2), nt=20)[0]

@@ -31,6 +31,17 @@ def test_pallas_whole_loop_matches_xla():
     assert not np.allclose(a, np.asarray(T))  # it did something
 
 
+def test_pallas_bf16():
+    """TPU-native dtype through both step implementations."""
+    import jax.numpy as jnp
+
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2, periodx=1, quiet=True)
+    T, Cp, p = init_diffusion3d(dtype=jnp.bfloat16)
+    a = np.asarray(make_step(p, impl="xla")(T, Cp)).astype(np.float32)
+    b = np.asarray(make_step(p, impl="pallas_interpret")(T, Cp)).astype(np.float32)
+    assert np.allclose(a, b, rtol=2e-2, atol=0.5)
+
+
 def test_pallas_f64():
     igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2, quiet=True)
     T, Cp, p = init_diffusion3d(dtype=np.float64)

@@ -199,8 +199,17 @@ def test_restore_1d_grid():
     assert np.array_equal(res, enc)
 
 
-@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32, np.complex128])
+def _bf16():
+    import jax.numpy as jnp
+
+    return jnp.bfloat16
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32,
+                                   np.complex128, "bfloat16"])
 def test_dtypes(dtype):
+    if dtype == "bfloat16":  # TPU-native dtype (reference has no analog)
+        dtype = _bf16()
     res, exp, _ = run_config(5, 5, 5, dims=(2, 2, 1), periods=(1, 1, 0), dtype=dtype)
     assert res.dtype == np.dtype(dtype)
     assert np.array_equal(res, exp)
