@@ -107,8 +107,8 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
     """
     if impl.startswith("pallas") and T.ndim == 3:
         from ..ops.pallas_stencil import (
-            diffusion3d_step_halo_pallas, diffusion3d_step_pallas,
-            fusable_halo_dims,
+            diffusion3d_step_halo_pallas, diffusion3d_step_halo_pallas_mp,
+            diffusion3d_step_pallas, fusable_halo_dims, mp_supported,
         )
 
         gg = global_grid()
@@ -119,11 +119,19 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
             # Self-neighbor halo updates folded into the step's output pass
             # (free); any remaining dims exchange afterwards, preserving the
             # z, x, y sequencing (fusable_halo_dims guarantees fused dims
-            # form a prefix of that order).
-            T = diffusion3d_step_halo_pallas(T, Cp, fuse=fuse, **kw)
+            # form a prefix of that order). The multi-plane kernel cuts the
+            # T read traffic ~2.4x where its shape gates pass.
+            if mp_supported(T):
+                T = diffusion3d_step_halo_pallas_mp(T, Cp, fuse=fuse, **kw)
+            else:
+                T = diffusion3d_step_halo_pallas(T, Cp, fuse=fuse, **kw)
             rest = [d for d in (2, 0, 1) if not fuse[d]]
             return local_update_halo(T, dims=rest) if rest else T
-        T = diffusion3d_step_pallas(T, Cp, **kw)
+        if mp_supported(T):
+            T = diffusion3d_step_halo_pallas_mp(
+                T, Cp, fuse=(False, False, False), **kw)
+        else:
+            T = diffusion3d_step_pallas(T, Cp, **kw)
     elif T.ndim == 3:
         qx = -p.lam * d_xi(T) / p.dx
         qy = -p.lam * d_yi(T) / p.dy

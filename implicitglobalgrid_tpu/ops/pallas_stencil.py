@@ -28,6 +28,7 @@ from __future__ import annotations
 from functools import partial
 
 __all__ = ["diffusion3d_step_pallas", "diffusion3d_step_halo_pallas",
+           "diffusion3d_step_halo_pallas_mp", "mp_supported",
            "pallas_supported", "fusable_halo_dims"]
 
 
@@ -188,3 +189,160 @@ def diffusion3d_step_pallas(T, Cp, *, lam, dt, dx, dy, dz, interpret=False):
         T, Cp, lam=lam, dt=dt, dx=dx, dy=dy, dz=dz,
         fuse=(False, False, False), interpret=interpret,
     )
+
+
+# ---------------------------------------------------------------------------
+# Multi-plane variant: P output planes per program through a DMA'd window.
+# ---------------------------------------------------------------------------
+
+_MP_PLANES = 8
+
+
+_MP_VMEM_BUDGET = 12 * 1024 * 1024  # leave headroom under the ~16 MB VMEM
+
+
+def mp_supported(T) -> bool:
+    """Whether the multi-plane kernel applies: enough planes, divisible by
+    the block factor, and the VMEM working set fits — scratch (P+2 planes)
+    plus double-buffered Cp in and out blocks (2*P planes each). The
+    plane-per-program kernel is the fallback for everything else."""
+    if not (T.ndim == 3 and T.shape[0] % _MP_PLANES == 0
+            and T.shape[0] >= 2 * _MP_PLANES):
+        return False
+    plane_bytes = int(T.shape[1]) * int(T.shape[2]) * T.dtype.itemsize
+    working_set = (5 * _MP_PLANES + 2) * plane_bytes
+    return working_set <= _MP_VMEM_BUDGET
+
+
+def _stencil_plane(tm, tc, tp, cp, *, lam, dt, dx, dy, dz):
+    """The flux-form update of one plane — the single shared arithmetic
+    (same accumulation order as the reference example and the
+    plane-per-program kernel)."""
+    import jax.numpy as jnp
+
+    qxr = -lam * (tp - tc) / dx
+    qxl = -lam * (tc - tm) / dx
+    acc = -((qxr - qxl) / dx)
+    qy = -lam * (tc[1:, :] - tc[:-1, :]) / dy
+    acc = acc - jnp.pad((qy[1:, :] - qy[:-1, :]) / dy, ((1, 1), (0, 0)))
+    qz = -lam * (tc[:, 1:] - tc[:, :-1]) / dz
+    acc = acc - jnp.pad((qz[:, 1:] - qz[:, :-1]) / dz, ((0, 0), (1, 1)))
+    return tc + dt * (acc / cp)
+
+
+def _mp_kernel(T_hbm, Cp_ref, out_ref, scratch, sem, *,
+               lam, dt, dx, dy, dz, nx, fuse):
+    """Compute P output planes from a (P+2)-plane VMEM window of T.
+
+    The window is DMA'd once per program, so interior T planes are read
+    ~(1+2/P)x instead of the 3x of the plane-per-program kernel's three
+    BlockSpec streams — the stencil's dominant HBM term. z/y halo edits are
+    in-plane selects like `_plane_halo_kernel`; x halo planes (if fused) are
+    NOT handled here — `diffusion3d_step_halo_pallas_mp` patches them with
+    the in-place dim-0 halo write afterwards.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    P = _MP_PLANES
+    fuse_x, fuse_y, fuse_z = fuse
+    i = pl.program_id(0)
+    g0 = i * P                                   # first output plane
+    start = jnp.clip(g0 - 1, 0, nx - (P + 2))    # window start (uniform size)
+    cp_dma = pltpu.make_async_copy(T_hbm.at[pl.ds(start, P + 2)], scratch, sem)
+    cp_dma.start()
+    cp_dma.wait()
+    l0 = g0 - start                              # window index of plane g0
+
+    ny, nz = out_ref.shape[1:]
+    row = lax.broadcasted_iota(jnp.int32, (ny, nz), 0)
+    col = lax.broadcasted_iota(jnp.int32, (ny, nz), 1)
+    interior_yz = (row > 0) & (row < ny - 1) & (col > 0) & (col < nz - 1)
+
+    for j in range(P):
+        g = g0 + j
+        l = l0 + j
+        tc = scratch[pl.ds(l, 1)][0]
+        tm = scratch[pl.ds(jnp.maximum(l - 1, 0), 1)][0]      # clamps at g==0
+        tp = scratch[pl.ds(jnp.minimum(l + 1, P + 1), 1)][0]  # ... at g==nx-1
+        upd = _stencil_plane(tm, tc, tp, Cp_ref[j],
+                             lam=lam, dt=dt, dx=dx, dy=dy, dz=dz)
+        u = jnp.where(interior_yz & (g > 0) & (g < nx - 1), upd, tc)
+        if fuse_z:
+            u = jnp.where(col == 0, u[:, nz - 2:nz - 1], u)
+            u = jnp.where(col == nz - 1, u[:, 1:2], u)
+        if fuse_y:
+            u = jnp.where(row == 0, u[ny - 2:ny - 1, :], u)
+            u = jnp.where(row == ny - 1, u[1:2, :], u)
+        out_ref[j] = u
+
+
+def diffusion3d_step_halo_pallas_mp(T, Cp, *, lam, dt, dx, dy, dz, fuse,
+                                    interpret=False):
+    """Multi-plane fused step (+ self-neighbor halo): the faster form of
+    `diffusion3d_step_halo_pallas` for blocks with `mp_supported` shapes.
+    Identical semantics; x halo planes (when fused) are recomputed at slab
+    level in XLA and written in place by the dim-0 halo kernel."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nx, ny, nz = T.shape
+    P = _MP_PLANES
+    blk = (P, ny, nz)
+    dtp = T.dtype.type
+    consts = dict(lam=dtp(lam), dt=dtp(dt), dx=dtp(dx), dy=dtp(dy), dz=dtp(dz))
+    kernel = partial(_mp_kernel, nx=nx,
+                     fuse=tuple(bool(f) for f in fuse), **consts)
+
+    try:
+        out_shape = jax.ShapeDtypeStruct(T.shape, T.dtype, vma=jax.typeof(T).vma)
+    except (AttributeError, TypeError):
+        out_shape = jax.ShapeDtypeStruct(T.shape, T.dtype)
+
+    U = pl.pallas_call(
+        kernel,
+        grid=(nx // P,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),          # T: manual DMA window
+            pl.BlockSpec(blk, lambda i: (i, 0, 0)),     # Cp
+        ],
+        out_specs=pl.BlockSpec(blk, lambda i: (i, 0, 0)),
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((P + 2, ny, nz), T.dtype),
+                        pltpu.SemaphoreType.DMA],
+        interpret=interpret,
+    )(T, Cp)
+
+    if not fuse[0]:
+        return U
+    # Fused x halo: plane 0 <- updated plane nx-2 (with z edits), plane nx-1
+    # <- updated plane 1, then their y halo rows — computed at slab level
+    # (reference order z, x, y; same corner argument as _plane_halo_kernel)
+    # and written IN PLACE by the dim-0 halo kernel (2-plane write).
+    from .pallas_halo import halo_write_inplace
+
+    def patch(src):  # src: global index of the source plane
+        tm = lax.slice_in_dim(T, src - 1, src, axis=0)[0]
+        tc = lax.slice_in_dim(T, src, src + 1, axis=0)[0]
+        tp = lax.slice_in_dim(T, src + 1, src + 2, axis=0)[0]
+        cp = lax.slice_in_dim(Cp, src, src + 1, axis=0)[0]
+        upd = _stencil_plane(tm, tc, tp, cp, **consts)
+        row = lax.broadcasted_iota(jnp.int32, (ny, nz), 0)
+        col = lax.broadcasted_iota(jnp.int32, (ny, nz), 1)
+        interior_yz = (row > 0) & (row < ny - 1) & (col > 0) & (col < nz - 1)
+        u = jnp.where(interior_yz, upd, tc)     # src planes are x-interior
+        if fuse[2]:
+            u = jnp.where(col == 0, u[:, nz - 2:nz - 1], u)
+            u = jnp.where(col == nz - 1, u[:, 1:2], u)
+        if fuse[1]:
+            u = jnp.where(row == 0, u[ny - 2:ny - 1, :], u)
+            u = jnp.where(row == ny - 1, u[1:2, :], u)
+        return u[None]
+
+    return halo_write_inplace(U, patch(nx - 2), patch(1), dim=0, hw=1,
+                              interpret=interpret)

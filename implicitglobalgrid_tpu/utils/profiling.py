@@ -1,0 +1,48 @@
+"""Profiling/tracing — the TPU-native upgrade of the reference's timing story.
+
+The reference offers only `tic`/`toc` (`/root/reference/src/tools.jl:230-236`)
+and keeps its streams/tasks persistent partly so external profilers can see
+the overlap structure (`src/update_halo.jl:207` note). On TPU the profiler IS
+the external tool: `jax.profiler` captures an XLA trace (HLO ops, fusion
+boundaries, collective overlap, HBM traffic) viewable in XProf/TensorBoard or
+Perfetto. This module wraps it with the framework's naming conventions:
+
+    with igg.trace("/tmp/igg_trace"):
+        T = run_diffusion(T, Cp, p, nt)          # whole hot loop captured
+
+    with igg.annotate("halo_z"):                  # named region in the trace
+        A = igg.update_halo(A)
+
+The capture contains the per-axis `ppermute` collectives and the Pallas
+kernels by name — the direct analog of inspecting the reference's
+max-priority-stream overlap in Nsight.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["trace", "annotate"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_link: bool = False):
+    """Capture a `jax.profiler` trace of the enclosed block into ``log_dir``.
+
+    The block's dispatched work is drained (`sync`-style barrier via
+    `jax.block_until_ready` on the profiler's own bookkeeping is NOT enough —
+    callers should pass their outputs through `igg.sync` before exiting the
+    block so trailing device work lands inside the capture window).
+    """
+    import jax
+
+    with jax.profiler.trace(log_dir, create_perfetto_link=create_perfetto_link):
+        yield
+
+
+def annotate(name: str):
+    """Named region in the profiler timeline (XLA `TraceAnnotation`): shows
+    up around everything dispatched inside the block."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

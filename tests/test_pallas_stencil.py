@@ -70,15 +70,17 @@ def test_fusable_halo_dims(dims, periods, expected_fuse):
     assert fusable_halo_dims(igg.global_grid()) == expected_fuse
 
 
+@pytest.mark.parametrize("nx", [16, 12])  # 16: multi-plane kernel; 12: plane-per-program
 @pytest.mark.parametrize("dims,periods", [
     ((1, 1, 1), (1, 1, 1)),  # all dims fused in-kernel
     ((2, 1, 1), (1, 1, 1)),  # mixed: fused z + ppermute x + local y
     ((1, 1, 1), (0, 0, 0)),  # no exchange at all
 ])
-def test_pallas_fused_halo_matches_xla(dims, periods):
-    """The fused step+halo kernel must reproduce the XLA step followed by the
+def test_pallas_fused_halo_matches_xla(dims, periods, nx):
+    """The fused step+halo kernels (both the multi-plane and the
+    plane-per-program form) must reproduce the XLA step followed by the
     sequential exchange — including corner propagation through the dims."""
-    igg.init_global_grid(16, 16, 16, dimx=dims[0], dimy=dims[1], dimz=dims[2],
+    igg.init_global_grid(nx, 16, 16, dimx=dims[0], dimy=dims[1], dimz=dims[2],
                          periodx=periods[0], periody=periods[1],
                          periodz=periods[2], quiet=True)
     T, Cp, p = init_diffusion3d(dtype=np.float32)
