@@ -14,9 +14,9 @@ Usage: python bench.py            (real TPU, f32, 256³/chip)
 
 from __future__ import annotations
 
-import json
 import sys
-import time
+
+import bench_util
 
 
 def main() -> None:
@@ -69,14 +69,19 @@ def main() -> None:
     rate = cells * steps / t
     rate_per_chip = rate / n_chips
     baseline = 0.95e9  # per-GPU reference throughput (BASELINE.md)
-    print(json.dumps({
+    bench_util.emit({
         "metric": "diffusion3D_cell_updates_per_s_per_chip",
         "value": rate_per_chip,
         "unit": "cell-updates/s/chip",
         "vs_baseline": rate_per_chip / baseline,
-    }))
+    })
     igg.finalize_global_grid()
 
 
 if __name__ == "__main__":
-    main()
+    if bench_util.is_child():
+        main()
+    else:
+        bench_util.run_with_retries(
+            "diffusion3D_cell_updates_per_s_per_chip", "cell-updates/s/chip"
+        )

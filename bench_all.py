@@ -21,6 +21,8 @@ from __future__ import annotations
 import json
 import sys
 
+import bench_util
+
 
 def _rate(cells, steps, t):
     return cells * steps / t
@@ -58,8 +60,7 @@ def main() -> None:
         row = {"metric": name, "value": value, "unit": unit}
         if baseline:
             row["vs_baseline"] = value / baseline
-        results.append(row)
-        print(json.dumps(row))
+        results.append(bench_util.emit(row))
 
     def timed(run_fn, state, nt, chunk):
         # warm both chunk programs, then time steady state
@@ -80,8 +81,7 @@ def main() -> None:
             "note": "no native f64 on this TPU generation; f64 semantics "
                     "verified on the x64 CPU mesh (tests, bench_all --cpu)",
         }
-        results.append(row)
-        print(json.dumps(row))
+        results.append(bench_util.emit(row))
     for dtype, tag in dtypes:
         igg.init_global_grid(nx, nx, nx, dimx=dims3[0], dimy=dims3[1],
                              dimz=dims3[2], periodx=1, periody=1, periodz=1,
@@ -138,4 +138,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if bench_util.is_child():
+        main()
+    else:
+        bench_util.run_with_retries("bench_all", "suite")

@@ -24,9 +24,9 @@ Usage: python bench_halo.py          (real chip, f32, 512^3 local)
 
 from __future__ import annotations
 
-import json
 import sys
-import time
+
+import bench_util
 
 
 def main() -> None:
@@ -78,14 +78,19 @@ def main() -> None:
     gbps = bytes_per_call * reps / t / 1e9
     # No published reference number exists (BASELINE.md: qualitative claim
     # only); vs_baseline is vs 1 GB/s/chip as a nominal floor.
-    print(json.dumps({
+    bench_util.emit({
         "metric": "update_halo_effective_GBps_per_chip",
         "value": gbps,
         "unit": "GB/s/chip",
         "vs_baseline": gbps / 1.0,
-    }))
+    })
     igg.finalize_global_grid()
 
 
 if __name__ == "__main__":
-    main()
+    if bench_util.is_child():
+        main()
+    else:
+        bench_util.run_with_retries(
+            "update_halo_effective_GBps_per_chip", "GB/s/chip"
+        )

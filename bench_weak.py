@@ -21,6 +21,8 @@ from __future__ import annotations
 import json
 import sys
 
+import bench_util
+
 
 def main() -> None:
     cpu = "--cpu" in sys.argv
@@ -73,15 +75,18 @@ def main() -> None:
     t1 = measure(1)
     tn = measure(n)
     eff = t1 / tn
-    print(json.dumps({
+    bench_util.emit({
         "metric": "weak_scaling_efficiency",
         "value": eff,
         "unit": f"t1/t{n}",
         "vs_baseline": eff / 0.90,   # north star: >=0.90 at scale
         "note": ("virtual CPU mesh (devices share host cores; understates "
                  "real hardware)" if cpu else "real devices"),
-    }))
+    })
 
 
 if __name__ == "__main__":
-    main()
+    if bench_util.is_child():
+        main()
+    else:
+        bench_util.run_with_retries("weak_scaling_efficiency", "t1/tN")

@@ -12,30 +12,40 @@ from __future__ import annotations
 from ..ops.fields import field_partition_spec
 from ..parallel.topology import check_initialized, global_grid
 
-__all__ = ["make_state_runner", "run_chunked"]
+__all__ = ["make_state_runner", "run_chunked", "default_check_vma"]
 
 _runner_cache: dict = {}
 
 
+def default_check_vma(step_uses_pallas: bool = False) -> bool:
+    """shard_map ``check_vma`` value for a step program: variance checking
+    stays ON unless Pallas kernels actually appear — either in the step
+    itself (``step_uses_pallas``) or via `local_update_halo`'s kernel tier
+    on the current grid (`ops.halo.halo_may_use_pallas`)."""
+    from ..ops.halo import halo_may_use_pallas
+
+    return not (step_uses_pallas or halo_may_use_pallas())
+
+
 def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
-                      check_vma: bool = True):
+                      check_vma: bool | None = None):
     """Compile ``state -> state`` advancing ``nt_chunk`` steps.
 
     ``step_local(state) -> state`` operates on a tuple of LOCAL blocks;
     ``state_ndims`` gives each block's ndim (its sharding spec). ``key``
     (hashable) identifies the step function for caching — required because
     closures are rebuilt per call; pass e.g. (model_name, params, nt_chunk).
+    ``check_vma=None`` resolves via `default_check_vma` (off only when the
+    halo layer emits Pallas kernels; pass False yourself if the step uses
+    Pallas directly).
     """
     import jax
     from jax import lax
 
     check_initialized()
     gg = global_grid()
-    if gg.device_type == "tpu" and bool(gg.use_pallas.any()):
-        # Pallas kernels (step and halo-write) may appear anywhere in the
-        # step when the Pallas tier is enabled and cannot express mesh-axis
-        # variance — vma checking stays on for pure-XLA configurations.
-        check_vma = False
+    if check_vma is None:
+        check_vma = default_check_vma()
     if key is not None:
         full_key = (gg.epoch, key, tuple(state_ndims), int(nt_chunk),
                     bool(check_vma))

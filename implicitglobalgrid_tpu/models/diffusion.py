@@ -175,9 +175,11 @@ def make_step(p: DiffusionParams, ndim: int = 3, impl: str | None = None):
     def local(T, Cp):
         return diffusion_step_local(T, Cp, p, impl)
 
+    from .common import default_check_vma
+
     return jax.jit(jax.shard_map(
         local, mesh=gg.mesh, in_specs=(spec, spec), out_specs=spec,
-        check_vma=not impl.startswith("pallas"),
+        check_vma=default_check_vma(impl.startswith("pallas")),
     ))
 
 
@@ -199,7 +201,7 @@ def make_run(p: DiffusionParams, nt_chunk: int, ndim: int = 3,
     return make_state_runner(
         step, (ndim, ndim), nt_chunk=nt_chunk,
         key=("diffusion", p, impl),
-        check_vma=not impl.startswith("pallas"),
+        check_vma=False if impl.startswith("pallas") else None,
     )
 
 

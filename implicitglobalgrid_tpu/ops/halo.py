@@ -48,7 +48,7 @@ from .fields import (
 )
 
 __all__ = ["update_halo", "local_update_halo", "free_update_halo_caches",
-           "DEFAULT_DIMS_ORDER"]
+           "halo_may_use_pallas", "DEFAULT_DIMS_ORDER"]
 
 # Reference default `dims=(3,1,2)` (1-based: z, x, y — update_halo.jl:29).
 DEFAULT_DIMS_ORDER = (2, 0, 1)
@@ -64,6 +64,23 @@ def free_update_halo_caches() -> None:
     """Drop compiled exchange programs (analog of
     `free_update_halo_buffers`, reference `update_halo.jl:103-108`)."""
     _exchange_cache.clear()
+
+
+def halo_may_use_pallas(gg=None) -> bool:
+    """Whether `local_update_halo` may emit Pallas kernels on the current
+    grid (in-place halo writes / single-pass self-exchange).
+
+    Enclosing `shard_map`s must pass ``check_vma=False`` when this is True —
+    Pallas outputs cannot express the mesh-axis variance the checker wants.
+    Model runners consult this instead of assuming from the device type, so
+    the variance check stays on for genuinely pure-XLA programs (e.g.
+    ``IGG_USE_PALLAS=0`` on a TPU grid)."""
+    if gg is None:
+        check_initialized()
+        gg = global_grid()
+    return _FORCE_PALLAS_WRITE_INTERPRET or (
+        gg.device_type == "tpu" and bool(gg.use_pallas.any())
+    )
 
 
 def _normalize_dims_order(dims):
@@ -246,6 +263,12 @@ def local_update_halo(*fields, dims=None):
     Arguments may be arrays or ``Field(A, halowidths)``; ``dims`` is the
     0-based dimension processing order (default z, x, y like the reference's
     `(3,1,2)`).
+
+    NOTE: on a default TPU grid this emits Pallas kernels (in-place halo
+    writes / single-pass self-exchange), which cannot pass `shard_map`'s
+    variance checker — build your enclosing `shard_map` with
+    ``check_vma=not halo_may_use_pallas()`` (the model runners in
+    `models/common.py` do this automatically).
     """
     check_initialized()
     gg = global_grid()
