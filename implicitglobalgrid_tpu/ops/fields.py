@@ -84,7 +84,7 @@ def extract(x):
 # Layout inference
 # ---------------------------------------------------------------------------
 
-def local_shape_of(shape) -> tuple:
+def local_shape_of(shape, layout: str | None = None) -> tuple:
     """Infer the LOCAL (per-shard) shape of an array of ``shape``.
 
     An array can be stacked/global (``shape[d] == dims[d] * l`` with ``l``
@@ -92,14 +92,31 @@ def local_shape_of(shape) -> tuple:
     most the extra staggering cells) or already local (``shape[d]`` itself
     within one overlap of ``nxyz[d]``). Staggering tolerance mirrors the
     reference's per-field overlap rule `ol(dim, A)` (`shared.jl:107`).
+
+    ``layout`` overrides the inference: ``"local"`` (the shape IS per-shard),
+    ``"stacked"`` (divide every sharded dim by ``dims[d]``), or ``None``
+    (infer). Pass it when block sizes are small enough to be ambiguous
+    (sizes within one overlap of ``dims*nxyz``).
     """
+    if layout not in (None, "local", "stacked"):
+        raise InvalidArgumentError(
+            f"layout must be None, 'local' or 'stacked'; got {layout!r}.")
     gg = global_grid()
+    if layout == "local":
+        return tuple(int(s) for s in shape)
     local = []
     for d in range(len(shape)):
         s = int(shape[d])
         dd = int(gg.dims[d]) if d < NDIMS else 1
         n = int(gg.nxyz[d]) if d < NDIMS else 1
         tol = int(gg.overlaps[d]) + 1 if d < NDIMS else 1
+        if layout == "stacked":
+            if s % dd != 0:
+                raise IncoherentArgumentError(
+                    f"Stacked array size {s} along dimension {d} is not divisible "
+                    f"by dims[{d}]={dd}.")
+            local.append(s // dd)
+            continue
         if dd == 1:
             local.append(s)
             continue

@@ -37,13 +37,14 @@ def _to_host(A) -> np.ndarray:
     return np.asarray(jax.device_get(A))
 
 
-def gather(A, A_global=None, *, root: int = 0):
+def gather(A, A_global=None, *, root: int = 0, layout: str | None = None):
     """Gather stacked field ``A`` to the host.
 
     Returns the full stacked array (shape ``dims .* local_shape`` — identical
     to the reference's ``A_global``) on the ``root`` process, ``None`` on
     others. If ``A_global`` (a numpy array) is given, the result is written
     into it in place (reference in-place signature `gather!(A, A_global)`).
+    ``layout`` disambiguates small blocks (see `local_shape_of`).
     """
     import jax
 
@@ -56,7 +57,7 @@ def gather(A, A_global=None, *, root: int = 0):
     # raise, or non-root processes would hang in the collective.
     host = _to_host(A)
     if me == root and A_global is not None:
-        loc = local_shape_of(A.shape)
+        loc = local_shape_of(A.shape, layout)
         expected = tuple(
             int(gg.dims[d]) * int(loc[d]) if d < 3 else int(loc[d])
             for d in range(len(loc))
@@ -75,7 +76,7 @@ def gather(A, A_global=None, *, root: int = 0):
     return host
 
 
-def gather_interior(A, *, root: int = 0):
+def gather_interior(A, *, root: int = 0, layout: str | None = None):
     """Gather ``A`` and strip the overlap duplication, returning the implicit
     global grid (per-array global size, ``nx_g(A) x ny_g(A) x nz_g(A)`` —
     reference `tools.jl:45-59`) on ``root``, ``None`` elsewhere.
@@ -94,7 +95,7 @@ def gather_interior(A, *, root: int = 0):
     if jax.process_index() != root:
         return None
 
-    loc = local_shape_of(host.shape)
+    loc = local_shape_of(host.shape, layout)
     nd = len(loc)
     out_shape = []
     for d in range(nd):

@@ -119,6 +119,16 @@ def _pallas_write_mode(gg, dim, shape, hw):
     return bool(gg.use_pallas[dim]) and gg.device_type == "tpu", False
 
 
+def _pallas_tier_enabled(gg, shape, dims_order) -> bool:
+    """Shared gate for the whole-exchange Pallas kernels (self-exchange and
+    combined one-pass): default order, 3-D, TPU with all per-dim flags on
+    (the kernels cover every dim at once), or the test force flag."""
+    if tuple(dims_order) != DEFAULT_DIMS_ORDER or len(shape) != 3:
+        return False
+    return _FORCE_PALLAS_WRITE_INTERPRET or (
+        bool(gg.use_pallas.all()) and gg.device_type == "tpu")
+
+
 def _self_exchange_plan(gg, shape, hws, dims_order):
     """If every participating dim of a field with this local ``shape`` takes
     the self-neighbor path, return (modes, ols) for the single-pass kernel
@@ -131,10 +141,7 @@ def _self_exchange_plan(gg, shape, hws, dims_order):
     """
     from .pallas_halo import self_exchange_supported
 
-    if tuple(dims_order) != DEFAULT_DIMS_ORDER or len(shape) != 3:
-        return None
-    if not (_FORCE_PALLAS_WRITE_INTERPRET
-            or (bool(gg.use_pallas.all()) and gg.device_type == "tpu")):
+    if not _pallas_tier_enabled(gg, shape, dims_order):
         return None
     modes = [False, False, False]
     ols = [0, 0, 0]
@@ -161,11 +168,99 @@ def _dim_exchanges(gg, shape, hws, dim) -> bool:
     participation gates of the per-dim loop)."""
     if dim >= len(shape):
         return False
-    D, periodic, _ = _dim_meta(gg, dim)
+    D, periodic, disp = _dim_meta(gg, dim)
     if D == 1 and not periodic:
         return False
+    if D > 1 and not periodic and disp >= D:
+        return False  # Cart_shift beyond the grid: all-PROC_NULL, no-op
     ol_d = int(gg.overlaps[dim] + (shape[dim] - gg.nxyz[dim]))
     return ol_d >= 2 * int(hws[dim])
+
+
+def _combined_plan(gg, shape, hws, dims_order):
+    """Participation modes for the combined one-pass exchange
+    (`pallas_halo.halo_write_combined_pallas`), or None if inapplicable.
+
+    Used when dim 2 exchanges with at least one ppermute dim in play (the
+    all-self case goes to the cheaper `halo_self_exchange_pallas`): dim 2's
+    lane-edge halo forces array-level traffic no matter what, so delivering
+    ALL dims' slabs in one full pass beats one array rewrite per dim.
+    """
+    from .pallas_halo import combined_write_supported
+
+    if not _pallas_tier_enabled(gg, shape, dims_order):
+        return None
+    modes = tuple(_dim_exchanges(gg, shape, hws, dim) for dim in range(3))
+    if not combined_write_supported(shape, modes, hws):
+        return None
+    return modes
+
+
+def _combined_exchange(gg, a, hws, modes, interpret):
+    """All-dims exchange with ONE unpack pass.
+
+    The permutes run first, in the reference's write order (z, x, y —
+    `update_halo.jl:29`), with each dim's SEND slabs patched with the
+    already-received slabs of earlier dims — slab-level corner propagation,
+    exactly equivalent to the sequential per-dim writes (a later dim's send
+    slab is extracted from the post-earlier-write array; here the write is
+    deferred, so the patch applies the earlier dims' received values to the
+    slab directly). Boundary masking uses the same patched "current halo"
+    slabs. Then `halo_write_combined_pallas` writes everything in one pass.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .pallas_halo import halo_write_combined_pallas
+
+    earlier = []  # [(dim, hw, (recv_l, recv_r))] in write order
+
+    def patch(slab, d, start, size):
+        """Apply earlier dims' received halo values to a slab spanning
+        [start, start+size) along d (full extent along other dims)."""
+        for e, hw_e, (rl, rr) in earlier:
+            rl_s = lax.slice_in_dim(rl, start, start + size, axis=d)
+            rr_s = lax.slice_in_dim(rr, start, start + size, axis=d)
+            slab = lax.dynamic_update_slice_in_dim(slab, rl_s, 0, axis=e)
+            slab = lax.dynamic_update_slice_in_dim(
+                slab, rr_s, slab.shape[e] - hw_e, axis=e)
+        return slab
+
+    recvs = {}
+    for dim in DEFAULT_DIMS_ORDER:
+        if not modes[dim]:
+            continue
+        D, periodic, disp = _dim_meta(gg, dim)
+        hw = int(hws[dim])
+        s = a.shape[dim]
+        ol_d = int(gg.overlaps[dim] + (a.shape[dim] - gg.nxyz[dim]))
+        send_r = patch(lax.slice_in_dim(a, s - ol_d, s - ol_d + hw, axis=dim),
+                       dim, s - ol_d, hw)
+        send_l = patch(lax.slice_in_dim(a, ol_d - hw, ol_d, axis=dim),
+                       dim, ol_d - hw, hw)
+        if D == 1:  # periodic self-neighbor: local swap
+            recv_l, recv_r = send_r, send_l
+        else:
+            if periodic:
+                perm_p = [(i, (i + disp) % D) for i in range(D)]
+                perm_m = [(i, (i - disp) % D) for i in range(D)]
+            else:
+                perm_p = [(i, i + disp) for i in range(D - disp)]
+                perm_m = [(i, i - disp) for i in range(disp, D)]
+            axis_name = AXIS_NAMES[dim]
+            recv_l = lax.ppermute(send_r, axis_name, perm_p)
+            recv_r = lax.ppermute(send_l, axis_name, perm_m)
+            if not periodic:  # PROC_NULL edges keep current (patched) halos
+                cur_l = patch(lax.slice_in_dim(a, 0, hw, axis=dim), dim, 0, hw)
+                cur_r = patch(lax.slice_in_dim(a, s - hw, s, axis=dim),
+                              dim, s - hw, hw)
+                idx = lax.axis_index(axis_name)
+                recv_l = jnp.where(idx >= disp, recv_l, cur_l)
+                recv_r = jnp.where(idx < D - disp, recv_r, cur_r)
+        recvs[dim] = (recv_l, recv_r)
+        earlier.append((dim, hw, recvs[dim]))
+    return halo_write_combined_pallas(a, recvs, modes=modes, hws=hws,
+                                      interpret=interpret)
 
 
 def _apply_self_exchange(gg, arrays, hws, dims_order):
@@ -183,6 +278,39 @@ def _apply_self_exchange(gg, arrays, hws, dims_order):
             )
             handled[i] = True
     return handled
+
+
+def _exchange_arrays(gg, arrays, hws, dims_order):
+    """Exchange every field's halos (local view; inside shard_map).
+    Mutates and returns ``arrays``. Kernel-path selection per field:
+    all-self single-pass kernel > combined one-pass unpack > per-dim."""
+    handled = _apply_self_exchange(gg, arrays, hws, dims_order)
+    for i, a in enumerate(arrays):
+        if handled[i]:
+            continue
+        modes = _combined_plan(gg, a.shape, hws[i], dims_order)
+        if modes is not None:
+            arrays[i] = _combined_exchange(
+                gg, a, hws[i], modes, _FORCE_PALLAS_WRITE_INTERPRET)
+            handled[i] = True
+    for dim in dims_order:
+        D, periodic, disp = _dim_meta(gg, dim)
+        if D == 1 and not periodic:
+            continue  # no neighbors along this axis (reference update_halo.jl:45 note)
+        for i, a in enumerate(arrays):
+            if handled[i] or dim >= a.ndim:
+                continue
+            hw = int(hws[i][dim])
+            ol_d = int(gg.overlaps[dim] + (a.shape[dim] - gg.nxyz[dim]))
+            if ol_d < 2 * hw:
+                continue  # computation overlap only, no halo (update_halo.jl:233)
+            pw, interp = _pallas_write_mode(gg, dim, a.shape, hw)
+            arrays[i] = _exchange_dim_local(
+                a, dim=dim, hw=hw, ol_d=ol_d, D=D, periodic=periodic,
+                disp=disp, axis_name=AXIS_NAMES[dim],
+                pallas_write=pw, interpret=interp,
+            )
+    return arrays
 
 
 def _exchange_dim_local(a, *, dim, hw, ol_d, D, periodic, disp, axis_name,
@@ -274,30 +402,8 @@ def local_update_halo(*fields, dims=None):
     gg = global_grid()
     dims_order = _normalize_dims_order(dims)
     fs = [wrap_field(f) for f in fields]
-    arrays = [f.A for f in fs]
-    # Fields whose every exchanging dim is self-neighbor: one kernel pass.
-    handled = _apply_self_exchange(gg, arrays, [f.halowidths for f in fs],
-                                   dims_order)
-    for dim in dims_order:
-        D, periodic, disp = _dim_meta(gg, dim)
-        if D == 1 and not periodic:
-            continue  # no neighbors along this axis (reference update_halo.jl:45 note)
-        for i, f in enumerate(fs):
-            if handled[i]:
-                continue
-            a = arrays[i]
-            if dim >= a.ndim:
-                continue
-            hw = int(f.halowidths[dim])
-            ol_d = int(gg.overlaps[dim] + (a.shape[dim] - gg.nxyz[dim]))
-            if ol_d < 2 * hw:
-                continue  # computation overlap only, no halo (update_halo.jl:233)
-            pw, interp = _pallas_write_mode(gg, dim, a.shape, hw)
-            arrays[i] = _exchange_dim_local(
-                a, dim=dim, hw=hw, ol_d=ol_d, D=D, periodic=periodic,
-                disp=disp, axis_name=AXIS_NAMES[dim],
-                pallas_write=pw, interpret=interp,
-            )
+    arrays = _exchange_arrays(gg, [f.A for f in fs],
+                              [f.halowidths for f in fs], dims_order)
     return arrays[0] if len(arrays) == 1 else tuple(arrays)
 
 
@@ -314,6 +420,7 @@ def _build_exchange_fn(gg, sig, dims_order):
     # the model step kernels, models/diffusion.py).
     any_pallas = any(
         _self_exchange_plan(gg, shape, hw, dims_order) is not None
+        or _combined_plan(gg, shape, hw, dims_order) is not None
         or any(
             _dim_exchanges(gg, shape, hw, dim)
             and _pallas_write_mode(gg, dim, shape, int(hw[dim]))[0]
@@ -323,26 +430,7 @@ def _build_exchange_fn(gg, sig, dims_order):
     )
 
     def exchange(*locals_):
-        arrays = list(locals_)
-        handled = _apply_self_exchange(gg, arrays, hws, dims_order)
-        for dim in dims_order:
-            D, periodic, disp = _dim_meta(gg, dim)
-            if D == 1 and not periodic:
-                continue
-            for i, a in enumerate(arrays):
-                if handled[i] or dim >= a.ndim:
-                    continue
-                hw = int(hws[i][dim])
-                ol_d = int(gg.overlaps[dim] + (a.shape[dim] - gg.nxyz[dim]))
-                if ol_d < 2 * hw:
-                    continue
-                pw, interp = _pallas_write_mode(gg, dim, a.shape, hw)
-                arrays[i] = _exchange_dim_local(
-                    a, dim=dim, hw=hw, ol_d=ol_d, D=D, periodic=periodic,
-                    disp=disp, axis_name=AXIS_NAMES[dim],
-                    pallas_write=pw, interpret=interp,
-                )
-        return tuple(arrays)
+        return tuple(_exchange_arrays(gg, list(locals_), hws, dims_order))
 
     shmapped = jax.shard_map(
         exchange, mesh=gg.mesh, in_specs=in_specs, out_specs=in_specs,

@@ -35,7 +35,8 @@ from __future__ import annotations
 from functools import partial
 
 __all__ = ["halo_write_supported", "halo_write_inplace",
-           "self_exchange_supported", "halo_self_exchange_pallas"]
+           "self_exchange_supported", "halo_self_exchange_pallas",
+           "combined_write_supported", "halo_write_combined_pallas"]
 
 _SUBLANE = 8
 _LANE = 128
@@ -223,6 +224,135 @@ def halo_self_exchange_pallas(a, *, modes, ols, interpret=False):
         out_shape=out_shape,
         interpret=interpret,
     )(a)
+
+
+def combined_write_supported(shape, modes, hws) -> bool:
+    """Whether `halo_write_combined_pallas` can deliver received slabs for
+    this local block: 3-D, dim 2 participating (otherwise the slab kernels
+    of `halo_write_inplace` already cost slab-level traffic and a full pass
+    would be a loss), and participating dims 1/2 with halowidth 1 (their
+    halo rows/lanes are placed by broadcast selects; wider halos fall back
+    to the per-dim path). dim 0's halowidth is unrestricted (whole planes).
+    """
+    if len(shape) != 3 or not modes[2]:
+        return False
+    if (modes[1] and int(hws[1]) != 1) or int(hws[2]) != 1:
+        return False
+    if modes[0] and int(shape[0]) < 2 * int(hws[0]):
+        return False
+    return True
+
+
+def halo_write_combined_pallas(a, recvs, *, modes, hws, interpret=False):
+    """Write ALL received halo slabs into ``a`` in ONE full-array pass.
+
+    The per-dim exchange pays roughly one full-array rewrite per dimension
+    on TPU (XLA's `dynamic_update_slice` unpack; the reference's analog is
+    its per-dim unpack kernels, `CUDAExt/update_halo.jl:210-227`). When
+    dim 2 participates its lane-edge tiles force array-level traffic anyway
+    (see `halo_write_supported`), so the optimal unpack is a single pass
+    that delivers every dim's slabs at once: read each x-plane, replace its
+    halo rows/lanes/planes, write it back — 1x read + 1x write total,
+    instead of ~3 rewrites.
+
+    ``recvs[d] = (recv_l, recv_r)`` for each participating dim ``d`` (slab
+    extent ``hws[d]`` along ``d``); caller has already applied boundary
+    masking, self-neighbor routing, and the sequential-corner patching
+    (`ops.halo._combined_exchange`), so precedence here is simply: base
+    plane (dim 0 halo planes come from ``recvs[0]``), then dim 2 lanes,
+    then dim 1 rows — the reference's z, x, y write order restricted to
+    this plane.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    nx, ny, nz = a.shape
+    modes = tuple(bool(m) for m in modes)
+    hwx = int(hws[0])
+
+    operands = [a]
+    in_specs = [pl.BlockSpec((1, ny, nz), lambda i: (i, 0, 0))]
+    vma = None
+    try:
+        vma = jax.typeof(a).vma
+    except (AttributeError, TypeError):
+        pass
+
+    if modes[0]:
+        rx = jnp.concatenate(recvs[0], axis=0)          # (2*hwx, ny, nz)
+        if vma is not None:
+            vma = vma | jax.typeof(rx).vma
+
+        def rx_map(i, nx=nx, hwx=hwx):
+            return (jnp.where(i < hwx, i,
+                              jnp.where(i >= nx - hwx, i - (nx - 2 * hwx), 0)),
+                    0, 0)
+
+        operands.append(rx)
+        in_specs.append(pl.BlockSpec((1, ny, nz), rx_map))
+    if modes[1]:
+        ry = jnp.concatenate(recvs[1], axis=1)          # (nx, 2, nz)
+        if vma is not None:
+            vma = vma | jax.typeof(ry).vma
+        operands.append(ry)
+        in_specs.append(pl.BlockSpec((1, 2, nz), lambda i: (i, 0, 0)))
+    if modes[2]:
+        rz = jnp.concatenate(recvs[2], axis=2)          # (nx, ny, 2)
+        if vma is not None:
+            vma = vma | jax.typeof(rz).vma
+        operands.append(rz)
+        in_specs.append(pl.BlockSpec((1, ny, 2), lambda i: (i, 0, 0)))
+
+    if vma is not None:
+        out_shape = jax.ShapeDtypeStruct(a.shape, a.dtype, vma=vma)
+    else:
+        out_shape = jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+    kernel = partial(_combined_write_kernel, nx=nx, hwx=hwx, modes=modes)
+    return pl.pallas_call(
+        kernel,
+        grid=(nx,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, ny, nz), lambda i: (i, 0, 0)),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*operands)
+
+
+def _combined_write_kernel(*refs, nx, hwx, modes):
+    """One output plane: base (own plane or a dim-0 halo plane from the
+    received stack), then dim 2 halo lanes, then dim 1 halo rows — the
+    reference's z, x, y precedence for this plane."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    it = iter(refs)
+    a_ref = next(it)
+    rx_ref = next(it) if modes[0] else None
+    ry_ref = next(it) if modes[1] else None
+    rz_ref = next(it) if modes[2] else None
+    o_ref = refs[-1]
+
+    u = a_ref[0]
+    ny, nz = u.shape
+    if modes[2]:  # z lanes first (halowidth 1, combined_write_supported)
+        col = lax.broadcasted_iota(jnp.int32, (ny, nz), 1)
+        u = jnp.where(col == 0, rz_ref[0, :, 0:1], u)
+        u = jnp.where(col == nz - 1, rz_ref[0, :, 1:2], u)
+    if modes[0]:
+        # dim 0 halo planes replace the whole plane INCLUDING its z lanes —
+        # the received planes carry the correct post-z-exchange corners
+        # (patched into the send slabs by the sender, ops.halo).
+        i = pl.program_id(0)
+        in_halo = (i < hwx) | (i >= nx - hwx)
+        u = jnp.where(in_halo, rx_ref[0], u)
+    if modes[1]:
+        row = lax.broadcasted_iota(jnp.int32, (ny, nz), 0)
+        u = jnp.where(row == 0, ry_ref[0, 0:1, :], u)
+        u = jnp.where(row == ny - 1, ry_ref[0, 1:2, :], u)
+    o_ref[0] = u
 
 
 def _self_exchange_kernel(a_ref, o_ref, *, modes, ols):

@@ -42,7 +42,7 @@ def _shape_of(A):
     raise InvalidArgumentError(f"Expected an array, got {type(A)}.")
 
 
-def _n_g(dim: int, A=None) -> int:
+def _n_g(dim: int, A=None, layout=None) -> int:
     """Global size along ``dim``; with an array, the array's own global size
     including staggering (reference `tools.jl:45-59`:
     ``nx_g(A) = nx_g() + (size(A,1) - nx)``)."""
@@ -50,25 +50,26 @@ def _n_g(dim: int, A=None) -> int:
     if A is None:
         return int(gg.nxyz_g[dim])
     shape = _shape_of(A)
-    loc = local_shape_of(shape)
+    loc = local_shape_of(shape, layout)
     size_d = loc[dim] if dim < len(loc) else 1
     return int(gg.nxyz_g[dim]) + (size_d - int(gg.nxyz[dim]))
 
 
-def nx_g(A=None) -> int:
+def nx_g(A=None, *, layout=None) -> int:
     """Size of the global grid in dimension x; ``nx_g(A)`` for array ``A``'s
-    global size (staggered arrays differ; reference `tools.jl:24,45`)."""
-    return _n_g(0, A)
+    global size (staggered arrays differ; reference `tools.jl:24,45`).
+    ``layout`` ("local"/"stacked") disambiguates small blocks."""
+    return _n_g(0, A, layout)
 
 
-def ny_g(A=None) -> int:
+def ny_g(A=None, *, layout=None) -> int:
     """Size of the global grid in dimension y (reference `tools.jl:31,52`)."""
-    return _n_g(1, A)
+    return _n_g(1, A, layout)
 
 
-def nz_g(A=None) -> int:
+def nz_g(A=None, *, layout=None) -> int:
     """Size of the global grid in dimension z (reference `tools.jl:38,59`)."""
-    return _n_g(2, A)
+    return _n_g(2, A, layout)
 
 
 def _coord_g(i0, dim: int, dcoord, size_d: int, coord):
@@ -100,7 +101,7 @@ def _coord_g(i0, dim: int, dcoord, size_d: int, coord):
     return x
 
 
-def _x_g(ix, dcoord, A, dim: int, coords=None):
+def _x_g(ix, dcoord, A, dim: int, coords=None, layout=None):
     """Scalar/per-index global coordinate for local index ``ix`` (0-based) of
     array ``A`` along ``dim``.
 
@@ -110,14 +111,19 @@ def _x_g(ix, dcoord, A, dim: int, coords=None):
       full 3-tuple) explicitly, or call inside `shard_map` where the mesh
       coordinate is taken from `lax.axis_index` (the analog of the reference
       reading the rank's `coords`, `tools.jl:100`).
+    - ``layout`` ("local"/"stacked") overrides the stacked-vs-local shape
+      inference for ambiguous block sizes (see `local_shape_of`).
     """
     check_initialized()
     gg = global_grid()
     shape = _shape_of(A)
-    loc = local_shape_of(shape)
+    loc = local_shape_of(shape, layout)
     size_d = loc[dim] if dim < len(loc) else 1
     shape_d = shape[dim] if dim < len(shape) else 1
-    stacked = shape_d != size_d or int(gg.dims[dim]) == 1
+    if layout is None:
+        stacked = shape_d != size_d or int(gg.dims[dim]) == 1
+    else:
+        stacked = layout == "stacked" or int(gg.dims[dim]) == 1
 
     if stacked and coords is None:
         coord, i_local = divmod(int(ix), size_d)
@@ -141,29 +147,29 @@ def _x_g(ix, dcoord, A, dim: int, coords=None):
     return _coord_g(ix, dim, dcoord, size_d, coord)
 
 
-def x_g(ix, dx, A, coords=None):
+def x_g(ix, dx, A, coords=None, *, layout=None):
     """Global x-coordinate of 0-based local index ``ix`` in array ``A``
     (reference `tools.jl:98-107`)."""
-    return _x_g(ix, dx, A, 0, coords)
+    return _x_g(ix, dx, A, 0, coords, layout)
 
 
-def y_g(iy, dy, A, coords=None):
+def y_g(iy, dy, A, coords=None, *, layout=None):
     """Global y-coordinate (reference `tools.jl:146-155`)."""
-    return _x_g(iy, dy, A, 1, coords)
+    return _x_g(iy, dy, A, 1, coords, layout)
 
 
-def z_g(iz, dz, A, coords=None):
+def z_g(iz, dz, A, coords=None, *, layout=None):
     """Global z-coordinate (reference `tools.jl:194-203`)."""
-    return _x_g(iz, dz, A, 2, coords)
+    return _x_g(iz, dz, A, 2, coords, layout)
 
 
-def _x_g_vec(dcoord, A, dim: int):
+def _x_g_vec(dcoord, A, dim: int, layout=None):
     """Stacked 1-D coordinate vector along ``dim`` for array/shape ``A``:
     entry ``i`` is the global coordinate of stacked index ``i``. Host-computed
     numpy (init-time only)."""
     check_initialized()
     shape = _shape_of(A) if hasattr(A, "shape") else tuple(A)
-    loc = local_shape_of(shape)
+    loc = local_shape_of(shape, layout)
     gg = global_grid()
     size_d = loc[dim] if dim < len(loc) else 1
     n_stack = int(gg.dims[dim]) * size_d if dim < NDIMS else size_d
@@ -172,17 +178,17 @@ def _x_g_vec(dcoord, A, dim: int):
     return _coord_g(i_local.astype(np.float64), dim, dcoord, size_d, coord.astype(np.float64))
 
 
-def x_g_vec(dx, A):
+def x_g_vec(dx, A, *, layout=None):
     """Vector of global x-coordinates for every stacked index of ``A``."""
-    return _x_g_vec(dx, A, 0)
+    return _x_g_vec(dx, A, 0, layout)
 
 
-def y_g_vec(dy, A):
-    return _x_g_vec(dy, A, 1)
+def y_g_vec(dy, A, *, layout=None):
+    return _x_g_vec(dy, A, 1, layout)
 
 
-def z_g_vec(dz, A):
-    return _x_g_vec(dz, A, 2)
+def z_g_vec(dz, A, *, layout=None):
+    return _x_g_vec(dz, A, 2, layout)
 
 
 def coords_g(dx, dy, dz, A):

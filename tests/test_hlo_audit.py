@@ -1,0 +1,115 @@
+"""HLO-level audit of the compiled halo exchange.
+
+Guards the framework's core performance claim — "the reference's
+pack/send/recv/unpack machinery collapses into one `collective-permute` pair
+per exchanging axis" (`ops/halo.py` module docstring) — against XLA
+regressions, the way the reference wire-tests its `isend_halo`/`irecv_halo!`
+requests (`/root/reference/test/test_update_halo.jl:925-970`): compile the
+exchange for a multi-shard mesh and string-match the optimized HLO.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+
+
+def _compiled_hlo(dims, periods, shape, n_fields=1, dims_order=None):
+    import jax
+    import jax.numpy as jnp
+
+    from implicitglobalgrid_tpu.ops import halo as halo_mod
+    from implicitglobalgrid_tpu.ops.fields import field_partition_spec
+
+    gg = igg.global_grid()
+    specs = (field_partition_spec(len(shape)),) * n_fields
+
+    def exchange(*arrays):
+        return tuple(halo_mod._exchange_arrays(
+            gg, list(arrays),
+            [gg.halowidths] * n_fields,
+            halo_mod._normalize_dims_order(dims_order),
+        ))
+
+    fn = jax.jit(jax.shard_map(
+        exchange, mesh=gg.mesh, in_specs=specs, out_specs=specs))
+    args = [jnp.zeros(tuple(d * s for d, s in zip(dims, shape)),
+                      np.float32) for _ in range(n_fields)]
+    return fn.lower(*args).compile().as_text()
+
+
+def _count_collective_permutes(hlo):
+    """collective-permute ops in the optimized HLO (start ops only — the
+    async pairs show up as collective-permute-start + -done)."""
+    starts = len(re.findall(r"collective-permute-start", hlo))
+    if starts:
+        return starts
+    return len(re.findall(r"= \S* ?collective-permute\(", hlo))
+
+
+def test_one_permute_pair_per_exchanging_axis():
+    """2x2x2 periodic: three exchanging axes -> exactly 6 permutes (one
+    left+right pair per axis), none more."""
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    hlo = _compiled_hlo((2, 2, 2), (1, 1, 1), (8, 8, 8))
+    assert _count_collective_permutes(hlo) == 6
+
+
+def test_self_neighbor_axes_emit_no_collectives():
+    """Periodic single-shard axes take the local-copy path: no collectives
+    at all (reference self-neighbor branch, `update_halo.jl:62-68`)."""
+    igg.init_global_grid(8, 8, 8, periodx=1, periody=1, periodz=1,
+                         dimx=1, dimy=1, dimz=1, quiet=True)
+    hlo = _compiled_hlo((1, 1, 1), (1, 1, 1), (8, 8, 8))
+    assert _count_collective_permutes(hlo) == 0
+    assert "all-reduce" not in hlo and "all-gather" not in hlo
+
+
+def test_non_exchanging_axis_emits_no_permute():
+    """dims=(2,1,4), periody=0: y has no neighbors -> only x and z axes
+    exchange -> 4 permutes."""
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=1, dimz=4,
+                         periodx=1, periody=0, periodz=1, quiet=True)
+    hlo = _compiled_hlo((2, 1, 4), (1, 0, 1), (8, 8, 8))
+    assert _count_collective_permutes(hlo) == 4
+
+
+def test_multi_field_shares_no_extra_collectives():
+    """Two fields exchanged in one program: permute count scales with
+    fields x axes (2 fields x 1 axis x 2 directions = 4), with no hidden
+    reduction/gather collectives."""
+    igg.init_global_grid(8, 8, 8, dimx=8, dimy=1, dimz=1,
+                         periodx=1, quiet=True)
+    hlo = _compiled_hlo((8, 1, 1), (1, 0, 0), (8, 8, 8), n_fields=2)
+    assert _count_collective_permutes(hlo) == 4
+    assert "all-reduce" not in hlo and "all-gather" not in hlo
+
+
+def test_no_full_array_copies_around_permutes():
+    """The permutes must ride on SLAB-sized operands — a full-array-shaped
+    copy feeding a collective-permute means XLA failed to fuse the slab
+    slicing (the whole point of the design). Checks every permute operand
+    shape is a halo slab, not the local block."""
+    igg.init_global_grid(16, 16, 16, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    hlo = _compiled_hlo((2, 2, 2), (1, 1, 1), (16, 16, 16))
+    # operand/result types of collective-permutes: f32[...]{...} shapes
+    for m in re.finditer(
+            r"collective-permute(?:-start)?\(([^)]*)\)", hlo):
+        for shape_m in re.finditer(r"f32\[([0-9,]+)\]", m.group(0)):
+            sizes = [int(s) for s in shape_m.group(1).split(",")]
+            assert np.prod(sizes) < 16 * 16 * 16, (
+                f"full-array-sized collective operand: {sizes}")
+
+
+def test_permute_count_with_halowidth_2():
+    """halowidth>1 exchanges still cost one pair per axis (slab width is
+    static, not a per-row loop)."""
+    igg.init_global_grid(12, 12, 12, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1,
+                         overlaps=(4, 4, 4), halowidths=(2, 2, 2), quiet=True)
+    hlo = _compiled_hlo((2, 2, 2), (1, 1, 1), (12, 12, 12))
+    assert _count_collective_permutes(hlo) == 6

@@ -118,3 +118,43 @@ def test_tic_toc():
     assert t >= 0.0
     with pytest.raises(Exception):
         igg.finalize_global_grid(); igg.tic()
+
+
+def test_layout_override_disambiguates_small_blocks():
+    """Explicit layout= kwarg vs the `local_shape_of` inference heuristic:
+    a block whose size equals dims*nxyz is read as stacked by default; the
+    override forces the local reading (and validates stacked divisibility)."""
+    from implicitglobalgrid_tpu.ops.fields import local_shape_of
+    from implicitglobalgrid_tpu.utils.exceptions import (
+        IncoherentArgumentError, InvalidArgumentError,
+    )
+
+    igg.init_global_grid(4, 4, 4, dimx=2, dimy=1, dimz=1, quiet=True)
+    # ambiguous: 8 == 2*4 (stacked) but could be a heavily staggered local
+    assert local_shape_of((8, 4, 4)) == (4, 4, 4)            # inferred stacked
+    assert local_shape_of((8, 4, 4), "local") == (8, 4, 4)
+    assert local_shape_of((8, 4, 4), "stacked") == (4, 4, 4)
+    # nx_g follows: nxyz_g = 2*(4-2)+2 = 6
+    A = np.zeros((8, 4, 4))
+    assert igg.nx_g(A) == 6
+    assert igg.nx_g(A, layout="local") == 6 + (8 - 4)
+    with pytest.raises(IncoherentArgumentError):
+        local_shape_of((7, 4, 4), "stacked")
+    with pytest.raises(InvalidArgumentError):
+        local_shape_of((8, 4, 4), "global")
+
+
+def test_layout_override_coordinate_helpers():
+    """x_g must honor layout= for the same ambiguous block the nx_g test
+    documents: a (8,4,4) LOCAL block on a dims=(2,1,1) grid reads as stacked
+    by default (divmod over the inferred shard), but layout='local' +
+    explicit coords gives the true local-block coordinates."""
+    igg.init_global_grid(4, 4, 4, dimx=2, dimy=1, dimz=1, quiet=True)
+    A = np.zeros((8, 4, 4))
+    # default inference: stacked -> ix=5 is shard 1, local 1 -> (1*(4-2)+1)
+    assert igg.x_g(5, 1.0, A) == 1 * (4 - 2) + 1
+    # forced local reading on shard 0: ix=5 is local index 5 of a staggered
+    # block (x0 offset = 0.5*(4-8)*dx = -2)
+    assert igg.x_g(5, 1.0, A, coords=0, layout="local") == 5 - 2.0
+    v = igg.x_g_vec(1.0, A, layout="local")
+    assert v.shape[0] == 2 * 8  # stacked vector over the local size

@@ -74,18 +74,19 @@ def oracle_update(P, local_shape, hw_list, order):
             continue
         D = int(gg.dims[dim])
         per = bool(gg.periods[dim])
+        disp = int(gg.disp)
         if D == 1 and not per:
             continue
         snap = P.copy()
         for c in range(D):
-            ln = (c - 1) % D if per else c - 1
+            ln = (c - disp) % D if per else c - disp
             if ln >= 0:
                 src = [slice(None)] * P.ndim
                 dst = [slice(None)] * P.ndim
                 src[dim] = _blk(ln, s, s - ol_d, s - ol_d + hw)   # right send slab
                 dst[dim] = _blk(c, s, 0, hw)                      # left halo
                 P[tuple(dst)] = snap[tuple(src)]
-            rn = (c + 1) % D if per else (c + 1 if c + 1 < D else -1)
+            rn = (c + disp) % D if per else (c + disp if c + disp < D else -1)
             if rn >= 0:
                 src = [slice(None)] * P.ndim
                 dst = [slice(None)] * P.ndim
@@ -97,13 +98,14 @@ def oracle_update(P, local_shape, hw_list, order):
 
 def run_config(nx, ny, nz, *, dims=(0, 0, 0), periods=(0, 0, 0),
                overlaps=(2, 2, 2), halowidths=None, stagger=(0, 0, 0),
-               dtype=np.float64, order=None, ndim=3):
+               dtype=np.float64, order=None, ndim=3, disp=1, reorder=1):
     """Init, build encoded field, zero halos, exchange, compare to oracle.
     Returns (result, oracle, reference_encoding)."""
     igg.init_global_grid(
         nx, ny, nz, dimx=dims[0], dimy=dims[1], dimz=dims[2],
         periodx=periods[0], periody=periods[1], periodz=periods[2],
         overlaps=overlaps, halowidths=halowidths, quiet=True,
+        disp=disp, reorder=reorder,
     )
     gg = igg.global_grid()
     base = [nx, ny, nz][:ndim]
@@ -367,6 +369,87 @@ def test_pallas_halo_kernels_match_dus(dims, periods, label):
     finally:
         halo_mod._FORCE_PALLAS_WRITE_INTERPRET = False
     assert np.array_equal(r_dus, r_pal), label
+
+
+@pytest.mark.parametrize("periods", [(1, 1, 1), (0, 0, 0), (1, 0, 1)])
+def test_restore_disp2_4shard(periods):
+    """disp=2 neighbor displacement (reference threads `disp` through
+    `Cart_shift`, `init_global_grid.jl:104-106`): slabs travel two shards."""
+    res, exp, enc = run_config(6, 5, 5, dims=(4, 1, 1), periods=periods,
+                               disp=2)
+    assert np.array_equal(res, exp)
+
+
+def test_restore_disp2_periodic_wrap():
+    """disp=2 on a 2-shard periodic axis wraps to self (coord+2 mod 2)."""
+    res, exp, enc = run_config(6, 5, 5, dims=(2, 2, 1), periods=(1, 1, 0),
+                               disp=2)
+    assert np.array_equal(res, exp)
+
+
+def test_reorder0_matches_reorder1():
+    """reorder=0 (keep device order) must produce the same exchange result
+    as the default reorder=1 (reference `Cart_create` reorder flag)."""
+    res1, exp1, _ = run_config(5, 5, 5, dims=(2, 2, 2), periods=(1, 0, 1))
+    igg.finalize_global_grid()
+    res0, exp0, _ = run_config(5, 5, 5, dims=(2, 2, 2), periods=(1, 0, 1),
+                               reorder=0)
+    assert np.array_equal(res0, exp0)
+    assert np.array_equal(res0, res1)
+
+
+# Combined one-pass unpack path (dim 2 participating with ppermute dims):
+# adversarial configs — staggering, disp, asymmetric halowidths, self/multi
+# mixes — against the XLA path.
+@pytest.mark.parametrize("dims,periods,kw,label", [
+    ((2, 2, 2), (1, 1, 1), {}, "all-periodic all-multi"),
+    ((2, 2, 2), (0, 0, 0), {}, "non-periodic PROC_NULL corners"),
+    ((1, 2, 2), (1, 0, 1), {}, "x self-neighbor + y PROC_NULL + z multi"),
+    ((2, 1, 2), (0, 1, 1), {}, "y self-neighbor mix"),
+    ((4, 1, 2), (1, 1, 1), {"disp": 2}, "disp=2 combined"),
+    ((2, 2, 2), (1, 1, 1),
+     {"overlaps": (4, 2, 2), "halowidths": (2, 1, 1)},
+     "halowidth 2 along x (whole-plane dim)"),
+    ((2, 2, 2), (1, 1, 1),
+     {"overlaps": (2, 4, 4), "halowidths": (1, 2, 2)},
+     "halowidth 2 along y,z: combined unsupported, per-dim fallback"),
+])
+def test_pallas_combined_unpack_matches_dus(dims, periods, kw, label):
+    import implicitglobalgrid_tpu.ops.halo as halo_mod
+
+    shape_local = (16, 16, 128)
+    igg.init_global_grid(*shape_local, dimx=dims[0], dimy=dims[1],
+                         dimz=dims[2], periodx=periods[0], periody=periods[1],
+                         periodz=periods[2], quiet=True, **kw)
+    rng = np.random.default_rng(2)
+    stacked = tuple(int(d * n) for d, n in zip(dims, shape_local))
+    A = igg.device_put_g(rng.standard_normal(stacked).astype(np.float32))
+    try:
+        halo_mod._FORCE_PALLAS_WRITE_INTERPRET = False
+        r_dus = np.asarray(igg.gather(igg.update_halo(A)))
+        halo_mod._FORCE_PALLAS_WRITE_INTERPRET = True
+        r_pal = np.asarray(igg.gather(igg.update_halo(A)))
+    finally:
+        halo_mod._FORCE_PALLAS_WRITE_INTERPRET = False
+    assert np.array_equal(r_dus, r_pal), label
+
+
+def test_pallas_combined_unpack_staggered_matches_dus():
+    """Staggered field (+1 along x) through the combined path."""
+    import implicitglobalgrid_tpu.ops.halo as halo_mod
+
+    igg.init_global_grid(16, 16, 128, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    rng = np.random.default_rng(3)
+    A = igg.device_put_g(rng.standard_normal((34, 32, 256)).astype(np.float32))
+    try:
+        halo_mod._FORCE_PALLAS_WRITE_INTERPRET = False
+        r_dus = np.asarray(igg.gather(igg.update_halo(A)))
+        halo_mod._FORCE_PALLAS_WRITE_INTERPRET = True
+        r_pal = np.asarray(igg.gather(igg.update_halo(A)))
+    finally:
+        halo_mod._FORCE_PALLAS_WRITE_INTERPRET = False
+    assert np.array_equal(r_dus, r_pal)
 
 
 def test_pallas_halo_multi_field_matches_dus():
