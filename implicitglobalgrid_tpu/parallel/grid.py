@@ -27,7 +27,7 @@ from ..utils.exceptions import (
     AlreadyInitializedError, IncoherentArgumentError, InvalidArgumentError,
 )
 from . import topology as top
-from .mesh import build_mesh, resolve_devices
+from .mesh import build_mesh, controller_coords_of, resolve_devices
 from .topology import GlobalGrid, NDIMS, dims_create, set_global_grid
 
 __all__ = ["init_global_grid", "finalize_global_grid", "select_device"]
@@ -175,9 +175,14 @@ def init_global_grid(
             f"Grid of {int(np.prod(dims))} shards exceeds the {len(devices)} available device(s)."
         )
 
-    mesh = build_mesh(tuple(int(d) for d in dims), devices, reorder)
+    mesh = build_mesh(tuple(int(d) for d in dims), devices, reorder,
+                      cfg.dcn_axes)
     me = jax.process_index()
-    coords = np.zeros(NDIMS, dtype=np.int64)  # controller coords; per-shard coords via axis_index
+    # This controller's Cartesian coords — its first addressable device's
+    # mesh position (reference per-rank `Cart_coords`,
+    # `init_global_grid.jl:101-106`). Zeros in single-controller runs, where
+    # per-shard coords come from `lax.axis_index` inside shard_map.
+    coords = controller_coords_of(mesh.devices, me)
 
     # THE implicit-global-grid formula (reference init_global_grid.jl:107).
     nxyz_g = dims * (nxyz - overlaps) + overlaps * (periods == 0)

@@ -6,16 +6,26 @@ Where the reference creates a Cartesian MPI communicator
 The reference's ``reorder`` argument (let MPI renumber ranks for locality) maps
 to letting `mesh_utils.create_device_mesh` pick an ICI-contiguous device
 layout; ``reorder=0`` keeps plain device order.
+
+Multi-slice deployments (the reference's multi-node story — it runs over any
+MPI interconnect, `/root/reference/README.md:6-8`): when the devices span
+several TPU slices, the grid axes named in ``IGG_TPU_DCN_AXES`` are laid out
+so that slice boundaries fall ONLY between blocks along those axes — every
+other axis' `ppermute` rides ICI; only the designated axes' boundary permutes
+cross DCN. `arrange_devices` implements the layout (hybrid
+`mesh_utils.create_hybrid_device_mesh` on real hardware, a deterministic
+block arrangement otherwise/as fallback).
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from ..utils.exceptions import InvalidArgumentError, NotLoadedError
+from ..utils.exceptions import IncoherentArgumentError, InvalidArgumentError, NotLoadedError
 from .topology import AXIS_NAMES, NDIMS
 
-__all__ = ["build_mesh", "resolve_devices"]
+__all__ = ["build_mesh", "resolve_devices", "arrange_devices",
+           "controller_coords_of"]
 
 
 def resolve_devices(device_type: str, platform_override: str | None = None):
@@ -39,16 +49,120 @@ def resolve_devices(device_type: str, platform_override: str | None = None):
     return devs, device_type
 
 
-def build_mesh(dims, devices, reorder: int = 1):
-    """Create the 3-axis mesh from ``devices``.
+def _slice_groups(devices):
+    """Group devices into DCN granules: by ``slice_index`` when the runtime
+    exposes distinct slices, else by ``process_index`` (the DCN boundary in
+    multi-host CPU/GPU deployments — and in multi-process runs where every
+    device reports slice 0). Returns a list of lists, sorted by key."""
+    for attr in ("slice_index", "process_index"):
+        groups: dict = {}
+        for d in devices:
+            groups.setdefault(getattr(d, attr, 0), []).append(d)
+        if len(groups) > 1:
+            return [groups[k] for k in sorted(groups)]
+    return [list(devices)]
 
-    - If the grid uses ALL devices and ``reorder`` is set, delegate to
-      `mesh_utils.create_device_mesh` so the mesh layout follows the physical
-      ICI topology (nearest mesh neighbors = nearest ICI neighbors, which is
-      what makes the per-axis `ppermute` halo exchange ride single ICI hops).
-    - Otherwise (a subset of devices, or ``reorder=0``), reshape in plain
-      enumeration order — the analog of `MPI.Cart_create(..., reorder=0)`.
+
+def _dcn_factorization(dims, dcn_axes, n_slices):
+    """Split ``dims`` into per-axis (dcn, ici) factors: the product of the
+    dcn factors over ``dcn_axes`` must equal ``n_slices``, each dividing its
+    axis' dims, factors as balanced as possible (fewest DCN boundary
+    crossings per axis)."""
+    axis_ids = {"x": 0, "y": 1, "z": 2}
+    sel = [axis_ids[a] for a in dcn_axes]
+    best = None
+
+    def search(i, rem, acc):
+        nonlocal best
+        if i == len(sel):
+            if rem == 1:
+                cand = tuple(acc)
+                score = (max(cand) - min(cand), max(cand))
+                if best is None or score < best[0]:
+                    best = (score, cand)
+            return
+        for f in range(1, min(int(dims[sel[i]]), rem) + 1):
+            if rem % f == 0 and int(dims[sel[i]]) % f == 0:
+                search(i + 1, rem // f, acc + [f])
+
+    search(0, int(n_slices), [])
+    if best is None:
+        raise IncoherentArgumentError(
+            f"Cannot distribute {n_slices} slice(s) over DCN axes {dcn_axes} "
+            f"with dims {tuple(int(x) for x in dims)}: the slice count must "
+            "factor into the dims of the designated axes."
+        )
+    dcn = [1, 1, 1]
+    for d, f in zip(sel, best[1]):
+        dcn[d] = f
+    return tuple(dcn), tuple(int(dims[d]) // dcn[d] for d in range(NDIMS))
+
+
+def arrange_devices(dims, devices, reorder: int = 1, dcn_axes=()):
+    """Arrange ``devices`` into a ``dims``-shaped object ndarray.
+
+    Single-granule (one slice / one process) grids use
+    `mesh_utils.create_device_mesh` (ICI-contiguous) when ``reorder`` is set
+    and the grid spans all devices, else plain enumeration order.
+
+    Multi-granule grids with ``dcn_axes``: the dims of the named axes are
+    factored as ``dcn * ici``; granule ``g`` (slice) occupies the block at
+    DCN position ``unravel(g, dcn_shape)``, arranged internally over the ICI
+    factors — so only the named axes' block boundaries cross DCN. Tries
+    `mesh_utils.create_hybrid_device_mesh` first on real hardware; falls
+    back to the deterministic block arrangement (also the testable path).
     """
+    dims = tuple(int(d) for d in dims)
+    n = int(np.prod(dims))
+    use = list(devices[:n])
+    groups = _slice_groups(use)
+
+    if len(groups) > 1 and dcn_axes:
+        dcn_shape, ici_shape = _dcn_factorization(dims, dcn_axes, len(groups))
+        per = n // len(groups)
+        if any(len(g) != per for g in groups):
+            raise IncoherentArgumentError(
+                f"Slices contribute unequal device counts "
+                f"({[len(g) for g in groups]}); a Cartesian hybrid mesh needs "
+                "equal-size slices."
+            )
+        if reorder:
+            try:
+                from jax.experimental import mesh_utils
+
+                return mesh_utils.create_hybrid_device_mesh(
+                    ici_shape, dcn_shape, use)
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    "create_hybrid_device_mesh failed "
+                    f"({e!r}); falling back to the deterministic block "
+                    "arrangement — intra-slice device order will not be "
+                    "ICI-optimized.")
+        out = np.empty(dims, dtype=object)
+        for g, devs in enumerate(groups):
+            gpos = np.unravel_index(g, dcn_shape)
+            block = np.array(devs, dtype=object).reshape(ici_shape)
+            sl = tuple(
+                slice(gpos[d] * ici_shape[d], (gpos[d] + 1) * ici_shape[d])
+                for d in range(NDIMS)
+            )
+            out[sl] = block
+        return out
+
+    if reorder and n == len(devices) and n > 1:
+        try:
+            from jax.experimental import mesh_utils
+
+            return mesh_utils.create_device_mesh(dims, devices=use)
+        except Exception:
+            pass  # fall back to plain order below
+    return np.array(use, dtype=object).reshape(dims)
+
+
+def build_mesh(dims, devices, reorder: int = 1, dcn_axes=()):
+    """Create the 3-axis mesh from ``devices`` (see `arrange_devices`)."""
     import jax
 
     dims = tuple(int(d) for d in dims)
@@ -60,15 +174,16 @@ def build_mesh(dims, devices, reorder: int = 1):
             f"Cannot create a {dims[0]}x{dims[1]}x{dims[2]} grid: requires {n} device(s), "
             f"but only {len(devices)} available."
         )
-    use = devices[:n]
-    dev_arr = None
-    if reorder and n == len(devices) and n > 1:
-        try:
-            from jax.experimental import mesh_utils
+    return jax.sharding.Mesh(arrange_devices(dims, devices, reorder, dcn_axes),
+                             AXIS_NAMES)
 
-            dev_arr = mesh_utils.create_device_mesh(dims, devices=use)
-        except Exception:
-            dev_arr = None  # fall back to plain order below
-    if dev_arr is None:
-        dev_arr = np.array(use, dtype=object).reshape(dims)
-    return jax.sharding.Mesh(dev_arr, AXIS_NAMES)
+
+def controller_coords_of(dev_array, process_index: int) -> np.ndarray:
+    """This controller's Cartesian coordinates: the mesh position of its
+    first addressable device (the analog of the reference's per-rank
+    `MPI.Cart_coords`, `init_global_grid.jl:101-106`). All-zeros in
+    single-process runs (the controller owns every shard)."""
+    for idx in np.ndindex(dev_array.shape):
+        if getattr(dev_array[idx], "process_index", 0) == process_index:
+            return np.array(idx, dtype=np.int64)
+    return np.zeros(dev_array.ndim, dtype=np.int64)

@@ -96,5 +96,9 @@ def read_env_config() -> EnvConfig:
             raise InvalidArgumentError(
                 f"Environment variable IGG_TPU_DCN_AXES: invalid axis name(s) {bad}; valid names are x, y, z."
             )
+        if len(set(names)) != len(names):
+            raise InvalidArgumentError(
+                f"Environment variable IGG_TPU_DCN_AXES: duplicate axis name(s) in {names}."
+            )
         cfg.dcn_axes = names
     return cfg

@@ -1,0 +1,134 @@
+"""Hybrid (multi-slice / DCN) mesh arrangement and controller coords.
+
+The reference scales over any MPI interconnect (`/root/reference/README.md:6-8`)
+with per-rank Cartesian coords from `MPI.Cart_coords`
+(`init_global_grid.jl:101-106`). The TPU analog: `arrange_devices` lays out
+multi-slice device pools so slice boundaries fall only between blocks of the
+axes named in ``IGG_TPU_DCN_AXES``, and `controller_coords_of` gives each
+controller its first addressable device's mesh position. Tested here with
+fake device objects (no multi-slice hardware needed).
+"""
+
+import numpy as np
+import pytest
+
+from implicitglobalgrid_tpu.parallel.mesh import (
+    arrange_devices, controller_coords_of,
+)
+from implicitglobalgrid_tpu.utils.exceptions import IncoherentArgumentError
+
+
+class FakeDev:
+    """Duck-typed device: id + slice/process membership."""
+
+    def __init__(self, id, slice_index=None, process_index=0):
+        self.id = id
+        if slice_index is not None:
+            self.slice_index = slice_index
+        self.process_index = process_index
+
+    def __repr__(self):
+        return f"d{self.id}"
+
+
+def _pool(n_slices, per_slice):
+    return [FakeDev(s * per_slice + i, slice_index=s, process_index=s)
+            for s in range(n_slices) for i in range(per_slice)]
+
+
+def _slice_of(d):
+    return d.slice_index
+
+
+def test_single_slice_plain_order():
+    devs = [FakeDev(i) for i in range(8)]
+    arr = arrange_devices((2, 2, 2), devs, reorder=0)
+    assert arr.shape == (2, 2, 2)
+    assert [d.id for d in arr.ravel()] == list(range(8))
+
+
+def test_two_slices_split_along_x():
+    """2 slices x 4 devices, dcn axis x, dims (4,2,1): slice boundary must
+    fall only between x-blocks 0-1 and 2-3."""
+    devs = _pool(2, 4)
+    arr = arrange_devices((4, 2, 1), devs, reorder=0, dcn_axes=("x",))
+    # x blocks [0,2) from slice 0, [2,4) from slice 1
+    for x in range(4):
+        for y in range(2):
+            assert _slice_of(arr[x, y, 0]) == (0 if x < 2 else 1)
+    # interior x-neighbor hops within a slice stay intra-slice
+    assert _slice_of(arr[0, 0, 0]) == _slice_of(arr[1, 0, 0])
+    assert _slice_of(arr[2, 0, 0]) == _slice_of(arr[3, 0, 0])
+
+
+def test_four_slices_two_dcn_axes():
+    """4 slices over axes (x, y) with dims (4,4,1): 2x2 DCN grid of 2x2 ICI
+    blocks."""
+    devs = _pool(4, 4)
+    arr = arrange_devices((4, 4, 1), devs, reorder=0, dcn_axes=("x", "y"))
+    for x in range(4):
+        for y in range(4):
+            expected = (x // 2) * 2 + (y // 2)
+            assert _slice_of(arr[x, y, 0]) == expected
+
+
+def test_all_slices_on_one_axis():
+    """4 slices all along z (dims (1,1,8), 2 devices each)."""
+    devs = _pool(4, 2)
+    arr = arrange_devices((1, 1, 8), devs, reorder=0, dcn_axes=("z",))
+    for z in range(8):
+        assert _slice_of(arr[0, 0, z]) == z // 2
+
+
+def test_indivisible_slice_count_raises():
+    devs = _pool(3, 4)  # 3 slices cannot split dims (4,1,1) along x
+    with pytest.raises(IncoherentArgumentError):
+        arrange_devices((4, 3, 1), devs, reorder=0, dcn_axes=("x",))
+
+
+def test_unequal_slices_raise():
+    devs = _pool(2, 4)[:-1] + [FakeDev(99, slice_index=0)]  # 5 + 3
+    with pytest.raises(IncoherentArgumentError):
+        arrange_devices((4, 2, 1), devs, reorder=0, dcn_axes=("x",))
+
+
+def test_no_dcn_axes_ignores_slices():
+    """Without IGG_TPU_DCN_AXES, multi-granule pools arrange in plain order
+    (the round-1 behavior, preserved for explicit layouts)."""
+    devs = _pool(2, 4)
+    arr = arrange_devices((2, 2, 2), devs, reorder=0)
+    assert [d.id for d in arr.ravel()] == list(range(8))
+
+
+def test_process_granules_without_slice_index():
+    """CPU/GPU multi-host pools have no slice_index; process_index is the
+    DCN granule."""
+    devs = [FakeDev(i, process_index=i // 4) for i in range(8)]
+    arr = arrange_devices((2, 2, 2), devs, reorder=0, dcn_axes=("x",))
+    for x in range(2):
+        for y in range(2):
+            for z in range(2):
+                assert arr[x, y, z].process_index == x
+
+
+def test_controller_coords():
+    devs = _pool(2, 4)
+    arr = arrange_devices((4, 2, 1), devs, reorder=0, dcn_axes=("x",))
+    assert tuple(controller_coords_of(arr, 0)) == (0, 0, 0)
+    assert tuple(controller_coords_of(arr, 1)) == (2, 0, 0)
+    # unknown process: zeros (single-controller semantics)
+    assert tuple(controller_coords_of(arr, 7)) == (0, 0, 0)
+
+
+def test_duplicate_dcn_axes_rejected():
+    import os
+
+    from implicitglobalgrid_tpu.utils.config import read_env_config
+    from implicitglobalgrid_tpu.utils.exceptions import InvalidArgumentError
+
+    os.environ["IGG_TPU_DCN_AXES"] = "x,x"
+    try:
+        with pytest.raises(InvalidArgumentError):
+            read_env_config()
+    finally:
+        del os.environ["IGG_TPU_DCN_AXES"]
