@@ -33,12 +33,22 @@ __all__ = ["DiffusionParams", "init_diffusion3d", "init_diffusion2d",
 
 @dataclass(frozen=True)
 class DiffusionParams:
-    """Physics/numerics constants (static: baked into the compiled program)."""
+    """Physics/numerics constants (static: baked into the compiled program).
+
+    ``overlap`` routes the XLA step through `hide_communication` (shell
+    update first, halo ppermutes overlap the interior compute — the
+    `@hide_communication` analog). It pays an extra interior-stitch pass, so
+    it wins only where collective latency is a significant fraction of the
+    step (small local blocks in strong scaling, DCN-crossing axes); at the
+    256^3 anchor size on ICI the default data-flow scheduling is faster.
+    The Pallas fused step+exchange path structures communication itself and
+    ignores this flag."""
     lam: float      # thermal conductivity
     dt: float
     dx: float
     dy: float = 1.0
     dz: float = 1.0
+    overlap: bool = False
 
 
 def _gaussian(x, amp, cx, w=1.0):
@@ -48,7 +58,7 @@ def _gaussian(x, amp, cx, w=1.0):
 
 
 def init_diffusion3d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, lz=10.0,
-                     dtype=None):
+                     dtype=None, overlap=False):
     """Build (T, Cp, params) with the reference example's initial conditions
     (two Gaussian anomalies each,
     `diffusion3D_multigpu_CuArrays_novis.jl:34-38`) as stacked sharded arrays.
@@ -73,7 +83,8 @@ def init_diffusion3d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, lz=10.0,
         + 50 * jnp.exp(-(((x - lx / 2) / 2) ** 2) - (((y - ly / 2) / 2) ** 2) - (((z - lz / 1.5) / 2) ** 2))
     T = device_put_g(jnp.broadcast_to(T, Tz.shape).astype(Tz.dtype))
     Cp = device_put_g(jnp.broadcast_to(Cp, Tz.shape).astype(Tz.dtype))
-    return T, Cp, DiffusionParams(lam=lam, dt=dt, dx=dx, dy=dy, dz=dz)
+    return T, Cp, DiffusionParams(lam=lam, dt=dt, dx=dx, dy=dy, dz=dz,
+                                  overlap=overlap)
 
 
 def init_diffusion2d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, dtype=None):
@@ -106,43 +117,69 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
     "pallas_interpret" for CPU testing). 3-D only for pallas.
     """
     if impl.startswith("pallas") and T.ndim == 3:
+        from ..ops.halo import _dim_exchanges
         from ..ops.pallas_stencil import (
-            diffusion3d_step_halo_pallas, diffusion3d_step_halo_pallas_mp,
-            diffusion3d_step_pallas, fusable_halo_dims, mp_supported,
+            diffusion3d_step_exchange_pallas, diffusion3d_step_halo_pallas,
+            diffusion3d_step_halo_pallas_mp, diffusion3d_step_pallas,
+            fusable_halo_dims, mp_supported, step_exchange_modes,
         )
 
         gg = global_grid()
+        interpret = impl == "pallas_interpret"
         kw = dict(lam=p.lam, dt=p.dt, dx=p.dx, dy=p.dy, dz=p.dz,
-                  interpret=(impl == "pallas_interpret"))
+                  interpret=interpret)
+        hws = tuple(int(h) for h in gg.halowidths)
         fuse = fusable_halo_dims(gg)
-        if fuse is not None:
-            # Self-neighbor halo updates folded into the step's output pass
-            # (free); any remaining dims exchange afterwards, preserving the
-            # z, x, y sequencing (fusable_halo_dims guarantees fused dims
-            # form a prefix of that order). The multi-plane kernel cuts the
-            # T read traffic ~2.4x where its shape gates pass.
+        covers_all = fuse is not None and not any(
+            _dim_exchanges(gg, T.shape, hws, d) for d in range(3)
+            if not fuse[d])
+        if covers_all:
+            # Every exchanging dim is self-neighbor: halo updates fold into
+            # the step's output pass for free (in-plane selects / sigma
+            # plane resourcing — no slab materialization at all). The
+            # multi-plane kernel cuts T read traffic ~2.4x where its shape
+            # gates pass.
             if mp_supported(T):
-                T = diffusion3d_step_halo_pallas_mp(T, Cp, fuse=fuse, **kw)
-            else:
-                T = diffusion3d_step_halo_pallas(T, Cp, fuse=fuse, **kw)
-            rest = [d for d in (2, 0, 1) if not fuse[d]]
-            return local_update_halo(T, dims=rest) if rest else T
+                return diffusion3d_step_halo_pallas_mp(T, Cp, fuse=fuse, **kw)
+            return diffusion3d_step_halo_pallas(T, Cp, fuse=fuse, **kw)
+        ex_modes = step_exchange_modes(gg, T)
+        if ex_modes is not None:
+            # Multi-shard (or mixed) exchange fused with the step: send
+            # slabs computed from thin input slabs, ppermuted while the
+            # plane sweep runs, delivered in the same output pass — the
+            # pod-scale path (~2 array passes/step regardless of sharding).
+            return diffusion3d_step_exchange_pallas(T, Cp, gg, ex_modes, **kw)
         if mp_supported(T):
             T = diffusion3d_step_halo_pallas_mp(
                 T, Cp, fuse=(False, False, False), **kw)
         else:
             T = diffusion3d_step_pallas(T, Cp, **kw)
     elif T.ndim == 3:
-        qx = -p.lam * d_xi(T) / p.dx
-        qy = -p.lam * d_yi(T) / p.dy
-        qz = -p.lam * d_zi(T) / p.dz
-        dTdt = (-d_xa(qx) / p.dx - d_ya(qy) / p.dy - d_za(qz) / p.dz) / inn(Cp)
-        T = T.at[1:-1, 1:-1, 1:-1].add(p.dt * dTdt)
+        def upd(Tb, Cpb):
+            qx = -p.lam * d_xi(Tb) / p.dx
+            qy = -p.lam * d_yi(Tb) / p.dy
+            qz = -p.lam * d_zi(Tb) / p.dz
+            dTdt = (-d_xa(qx) / p.dx - d_ya(qy) / p.dy
+                    - d_za(qz) / p.dz) / inn(Cpb)
+            return Tb.at[1:-1, 1:-1, 1:-1].add(p.dt * dTdt)
+
+        if p.overlap:
+            from ..ops.overlap import hide_communication
+
+            return hide_communication(upd, T, Cp, radius=1)
+        T = upd(T, Cp)
     else:
-        qx = -p.lam * d_xi(T) / p.dx
-        qy = -p.lam * d_yi(T) / p.dy
-        dTdt = (-d_xa(qx) / p.dx - d_ya(qy) / p.dy) / inn(Cp)
-        T = T.at[1:-1, 1:-1].add(p.dt * dTdt)
+        def upd2(Tb, Cpb):
+            qx = -p.lam * d_xi(Tb) / p.dx
+            qy = -p.lam * d_yi(Tb) / p.dy
+            dTdt = (-d_xa(qx) / p.dx - d_ya(qy) / p.dy) / inn(Cpb)
+            return Tb.at[1:-1, 1:-1].add(p.dt * dTdt)
+
+        if p.overlap:
+            from ..ops.overlap import hide_communication
+
+            return hide_communication(upd2, T, Cp, radius=1)
+        T = upd2(T, Cp)
     return local_update_halo(T)
 
 

@@ -196,22 +196,27 @@ def _combined_plan(gg, shape, hws, dims_order):
     return modes
 
 
-def _combined_exchange(gg, a, hws, modes, interpret):
-    """All-dims exchange with ONE unpack pass.
+def exchange_recv_slabs(gg, shape, hws, modes, get_slab):
+    """Masked, corner-patched RECEIVED slabs for every participating dim.
 
-    The permutes run first, in the reference's write order (z, x, y —
-    `update_halo.jl:29`), with each dim's SEND slabs patched with the
-    already-received slabs of earlier dims — slab-level corner propagation,
-    exactly equivalent to the sequential per-dim writes (a later dim's send
-    slab is extracted from the post-earlier-write array; here the write is
-    deferred, so the patch applies the earlier dims' received values to the
-    slab directly). Boundary masking uses the same patched "current halo"
-    slabs. Then `halo_write_combined_pallas` writes everything in one pass.
+    The slab pipeline of the combined one-pass exchange: per dim, in the
+    reference's write order (z, x, y — `update_halo.jl:29`), extract the
+    send slabs, patch them with earlier dims' received values (slab-level
+    corner propagation — exactly equivalent to the sequential per-dim
+    writes, where a later dim's send slab is extracted from the
+    post-earlier-write array), permute (or swap locally for self-neighbor
+    dims), and mask non-periodic boundaries with the patched current halos
+    (the PROC_NULL no-op, `init_global_grid.jl:103`).
+
+    ``get_slab(dim, start, size)`` returns the pre-exchange state values at
+    ``[start, start+size)`` along ``dim`` (full extent elsewhere) — a plain
+    slice for a standalone exchange, or a freshly COMPUTED slab when a model
+    fuses its update step with the exchange (`models/diffusion`).
+
+    Returns ``{dim: (recv_l, recv_r)}``.
     """
     import jax.numpy as jnp
     from jax import lax
-
-    from .pallas_halo import halo_write_combined_pallas
 
     earlier = []  # [(dim, hw, (recv_l, recv_r))] in write order
 
@@ -232,12 +237,10 @@ def _combined_exchange(gg, a, hws, modes, interpret):
             continue
         D, periodic, disp = _dim_meta(gg, dim)
         hw = int(hws[dim])
-        s = a.shape[dim]
-        ol_d = int(gg.overlaps[dim] + (a.shape[dim] - gg.nxyz[dim]))
-        send_r = patch(lax.slice_in_dim(a, s - ol_d, s - ol_d + hw, axis=dim),
-                       dim, s - ol_d, hw)
-        send_l = patch(lax.slice_in_dim(a, ol_d - hw, ol_d, axis=dim),
-                       dim, ol_d - hw, hw)
+        s = shape[dim]
+        ol_d = int(gg.overlaps[dim] + (shape[dim] - gg.nxyz[dim]))
+        send_r = patch(get_slab(dim, s - ol_d, hw), dim, s - ol_d, hw)
+        send_l = patch(get_slab(dim, ol_d - hw, hw), dim, ol_d - hw, hw)
         if D == 1:  # periodic self-neighbor: local swap
             recv_l, recv_r = send_r, send_l
         else:
@@ -251,14 +254,28 @@ def _combined_exchange(gg, a, hws, modes, interpret):
             recv_l = lax.ppermute(send_r, axis_name, perm_p)
             recv_r = lax.ppermute(send_l, axis_name, perm_m)
             if not periodic:  # PROC_NULL edges keep current (patched) halos
-                cur_l = patch(lax.slice_in_dim(a, 0, hw, axis=dim), dim, 0, hw)
-                cur_r = patch(lax.slice_in_dim(a, s - hw, s, axis=dim),
-                              dim, s - hw, hw)
+                cur_l = patch(get_slab(dim, 0, hw), dim, 0, hw)
+                cur_r = patch(get_slab(dim, s - hw, hw), dim, s - hw, hw)
                 idx = lax.axis_index(axis_name)
                 recv_l = jnp.where(idx >= disp, recv_l, cur_l)
                 recv_r = jnp.where(idx < D - disp, recv_r, cur_r)
         recvs[dim] = (recv_l, recv_r)
         earlier.append((dim, hw, recvs[dim]))
+    return recvs
+
+
+def _combined_exchange(gg, a, hws, modes, interpret):
+    """All-dims exchange with ONE unpack pass: the `exchange_recv_slabs`
+    pipeline on plain slices, then `halo_write_combined_pallas` writes every
+    received slab in a single full-array pass."""
+    from jax import lax
+
+    from .pallas_halo import halo_write_combined_pallas
+
+    recvs = exchange_recv_slabs(
+        gg, a.shape, hws, modes,
+        lambda dim, start, size: lax.slice_in_dim(a, start, start + size,
+                                                  axis=dim))
     return halo_write_combined_pallas(a, recvs, modes=modes, hws=hws,
                                       interpret=interpret)
 
