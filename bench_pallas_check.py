@@ -28,7 +28,7 @@ def _checks(interpret: bool):
 
     import implicitglobalgrid_tpu as igg
     from implicitglobalgrid_tpu.models import (
-        init_diffusion3d, make_run, run_diffusion,
+        init_diffusion3d, run_diffusion,
     )
     from implicitglobalgrid_tpu.ops import pallas_halo as ph
     from implicitglobalgrid_tpu.ops import pallas_stencil as ps
@@ -47,16 +47,13 @@ def _checks(interpret: bool):
             yield_row(name, False, traceback.format_exc()[-600:])
             return False
 
-    rows = []
-
     def yield_row(name, ok, note):
-        row = bench_util.emit({
+        bench_util.emit({
             "metric": f"pallas_check_{name}",
             "value": 1.0 if ok else 0.0,
             "unit": "pass",
             **({"note": note} if note else {}),
         })
-        rows.append(row)
 
     results = []
 

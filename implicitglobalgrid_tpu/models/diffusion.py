@@ -114,7 +114,8 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
 
     ``impl``: "xla" (broadcast flux form, fused by XLA) or "pallas" (fused
     single-pass Pallas TPU kernel, same arithmetic to the last ulp;
-    "pallas_interpret" for CPU testing). 3-D only for pallas.
+    "pallas_interpret" for CPU testing). Pallas covers 3-D and 2-D
+    blocks; other ndims fall back to the XLA path.
     """
     if impl.startswith("pallas") and T.ndim == 3:
         from ..ops.halo import _dim_exchanges
@@ -149,11 +150,41 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
             # plane sweep runs, delivered in the same output pass — the
             # pod-scale path (~2 array passes/step regardless of sharding).
             return diffusion3d_step_exchange_pallas(T, Cp, gg, ex_modes, **kw)
+        if fuse is not None:
+            # Partial fusion (a self-neighbor prefix of the z, x, y order
+            # fuses in-kernel; a later dim is nonstandard): exchange only
+            # the REMAINING dims afterwards — the suffix of the order, so
+            # the reference's sequential-corner semantics hold.
+            if mp_supported(T):
+                T = diffusion3d_step_halo_pallas_mp(T, Cp, fuse=fuse, **kw)
+            else:
+                T = diffusion3d_step_halo_pallas(T, Cp, fuse=fuse, **kw)
+            from ..ops.halo import DEFAULT_DIMS_ORDER
+
+            rem = tuple(d for d in DEFAULT_DIMS_ORDER if not fuse[d])
+            return local_update_halo(T, dims=rem)
         if mp_supported(T):
             T = diffusion3d_step_halo_pallas_mp(
                 T, Cp, fuse=(False, False, False), **kw)
         else:
             T = diffusion3d_step_pallas(T, Cp, **kw)
+    elif impl.startswith("pallas") and T.ndim == 2:
+        from ..ops.pallas_stencil import (
+            diffusion2d_step_exchange_pallas, step_exchange_modes,
+            strip_rows_2d,
+        )
+
+        gg = global_grid()
+        interpret = impl == "pallas_interpret"
+        ex_modes = step_exchange_modes(gg, T)
+        if ex_modes is not None and strip_rows_2d(T) is not None:
+            # 2-D fused step + exchange (BASELINE config 2): row strips
+            # through a double-buffered VMEM window; send slabs from thin
+            # XLA slab computes, delivered in the same output pass.
+            return diffusion2d_step_exchange_pallas(
+                T, Cp, gg, ex_modes, lam=p.lam, dt=p.dt, dx=p.dx, dy=p.dy,
+                interpret=interpret)
+        return diffusion_step_local(T, Cp, p, impl="xla")
     elif T.ndim == 3:
         def upd(Tb, Cpb):
             qx = -p.lam * d_xi(Tb) / p.dx
@@ -187,14 +218,15 @@ def _resolve_impl(impl, ndim=3):
     """Default impl: the grid's IGG_USE_PALLAS flag (the analog of the
     reference's per-dimension copy-kernel toggle IGG_USE_POLYESTER,
     `init_global_grid.jl:60,71-75`) selects the Pallas kernels on TPU grids
-    (on by default there). Only the 3-D step has a Pallas kernel — other
-    ndims resolve to the XLA path so check_vma stays on for them. The fused
-    step kernel covers all dims at once, so ANY explicit per-dim opt-out
-    (e.g. IGG_USE_PALLAS_DIMX=0) falls back to the XLA path."""
+    (on by default there). The 3-D and 2-D steps have Pallas kernels —
+    other ndims resolve to the XLA path so check_vma stays on for them. The
+    fused step kernel covers all dims at once, so ANY explicit per-dim
+    opt-out (e.g. IGG_USE_PALLAS_DIMX=0) falls back to the XLA path."""
     if impl is not None:
         return impl
     gg = global_grid()
-    if ndim == 3 and bool(gg.use_pallas.all()) and gg.device_type == "tpu":
+    if ndim in (2, 3) and bool(gg.use_pallas.all()) \
+            and gg.device_type == "tpu":
         return "pallas"
     return "xla"
 

@@ -30,7 +30,8 @@ from functools import partial
 __all__ = ["diffusion3d_step_pallas", "diffusion3d_step_halo_pallas",
            "diffusion3d_step_halo_pallas_mp", "mp_supported",
            "pallas_supported", "fusable_halo_dims",
-           "step_exchange_modes", "diffusion3d_step_exchange_pallas"]
+           "step_exchange_modes", "diffusion3d_step_exchange_pallas",
+           "strip_rows_2d", "diffusion2d_step_exchange_pallas"]
 
 
 def pallas_supported(T) -> bool:
@@ -210,13 +211,16 @@ def step_exchange_modes(gg, T):
     halowidth 1 and the block is unstaggered (``T.shape == nxyz`` — the
     flagship model's fields), with at least one exchanging dim. Self and
     multi-shard dims mix freely (self dims become local swaps in the slab
-    pipeline)."""
-    if T.ndim != 3 or T.shape[0] < 3:
+    pipeline). 2-D blocks are eligible too (the returned 3-tuple then has
+    ``modes[2] = False``; grid dims beyond the array's rank never apply to
+    it, mirroring `ops.halo._dim_exchanges`)."""
+    if T.ndim not in (2, 3) or T.shape[0] < 3:
         return None
-    if tuple(int(s) for s in T.shape) != tuple(int(n) for n in gg.nxyz):
+    if tuple(int(s) for s in T.shape) != tuple(
+            int(n) for n in gg.nxyz[:T.ndim]):
         return None
     modes = [False, False, False]
-    for dim in range(3):
+    for dim in range(T.ndim):
         D = int(gg.dims[dim])
         periodic = bool(gg.periods[dim])
         disp = int(gg.disp)
@@ -235,7 +239,8 @@ def step_exchange_modes(gg, T):
 def _xla_update_slab(T, Cp, dim, start, size, consts):
     """Updated-state values at ``[start, start+size)`` along ``dim`` (full
     extent elsewhere), computed from a thin input slab grown by the stencil
-    radius (1).
+    radius (1). Works for 3-D and 2-D blocks (`_stencil_plane` /
+    `_stencil_row` arithmetic respectively).
 
     Cells on the GLOBAL block boundary keep their input values. Slab-edge
     x-neighbors are edge-clones; this is sound because for every range this
@@ -252,11 +257,12 @@ def _xla_update_slab(T, Cp, dim, start, size, consts):
     Cs = lax.slice_in_dim(Cp, lo, hi, axis=dim)
     tm = jnp.concatenate([Ts[:1], Ts[:-1]], axis=0)
     tp = jnp.concatenate([Ts[1:], Ts[-1:]], axis=0)
-    upd = _stencil_plane(tm, Ts, tp, Cs, **consts)
+    stencil = _stencil_plane if T.ndim == 3 else _stencil_row
+    upd = stencil(tm, Ts, tp, Cs, **consts)
     # global-interior mask (dim positions offset by lo; other dims span the
     # full block so slab positions are global)
     m = None
-    for d in range(3):
+    for d in range(T.ndim):
         pos = lax.broadcasted_iota(jnp.int32, Ts.shape, d)
         if d == dim:
             pos = pos + lo
@@ -375,23 +381,39 @@ def diffusion3d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
 # Multi-plane variant: P output planes per program through a DMA'd window.
 # ---------------------------------------------------------------------------
 
-_MP_PLANES = 8
+_MP_CANDIDATES = (32, 16, 8, 4)    # preferred plane counts, best first
 
 
-_MP_VMEM_BUDGET = 12 * 1024 * 1024  # leave headroom under the ~16 MB VMEM
+_MP_VMEM_BUDGET = 13 * 1024 * 1024  # leave headroom under the ~16 MB VMEM
+
+
+_MP_TEMP_PLANES = 6  # slack for Mosaic stencil temporaries (qy/qz/acc/masks)
+
+
+def mp_planes(T):
+    """Plane count P for the multi-plane kernel, or None if unsupported.
+
+    Picks the largest candidate P that divides the plane axis with >= 2
+    programs and whose VMEM working set fits: double-buffered (P+2)-plane T
+    windows (2*(P+2)) plus double-buffered Cp in and out blocks (2*P each)
+    plus temporaries slack. Larger P amortizes the 2-plane window overlap
+    (T read amplification 1+2/P); the plane-per-program kernel is the
+    fallback for everything else."""
+    if T.ndim != 3:
+        return None
+    plane_bytes = int(T.shape[1]) * int(T.shape[2]) * T.dtype.itemsize
+    for P in _MP_CANDIDATES:
+        if T.shape[0] % P or T.shape[0] < 2 * P:
+            continue
+        working_set = (6 * P + 4 + _MP_TEMP_PLANES) * plane_bytes
+        if working_set <= _MP_VMEM_BUDGET:
+            return P
+    return None
 
 
 def mp_supported(T) -> bool:
-    """Whether the multi-plane kernel applies: enough planes, divisible by
-    the block factor, and the VMEM working set fits — scratch (P+2 planes)
-    plus double-buffered Cp in and out blocks (2*P planes each). The
-    plane-per-program kernel is the fallback for everything else."""
-    if not (T.ndim == 3 and T.shape[0] % _MP_PLANES == 0
-            and T.shape[0] >= 2 * _MP_PLANES):
-        return False
-    plane_bytes = int(T.shape[1]) * int(T.shape[2]) * T.dtype.itemsize
-    working_set = (5 * _MP_PLANES + 2) * plane_bytes
-    return working_set <= _MP_VMEM_BUDGET
+    """Whether the multi-plane kernel applies (see `mp_planes`)."""
+    return mp_planes(T) is not None
 
 
 def _stencil_plane(tm, tc, tp, cp, *, lam, dt, dx, dy, dz):
@@ -414,31 +436,95 @@ def _stencil_plane(tm, tc, tp, cp, *, lam, dt, dx, dy, dz):
     return tc + dt * (acc / cp)
 
 
-def _mp_kernel(T_hbm, Cp_ref, out_ref, scratch, sem, *,
-               lam, dt, dx, dy, dz, nx, fuse):
+def _stencil_row(tm, tc, tp, cp, *, lam, dt, dx, dy):
+    """2-D flux-form update of a row strip: the x-derivative comes from the
+    ``tm``/``tc``/``tp`` row triple, the y-derivative runs over the LAST
+    axis — same accumulation order as the XLA 2-D step
+    (`models/diffusion.upd2`, mirroring the reference example's sequence)."""
+    import jax.numpy as jnp
+
+    zeros = [(0, 0)] * (tc.ndim - 1)
+    qxr = -lam * (tp - tc) / dx
+    qxl = -lam * (tc - tm) / dx
+    acc = -((qxr - qxl) / dx)
+    qy = -lam * (tc[..., 1:] - tc[..., :-1]) / dy
+    acc = acc - jnp.pad((qy[..., 1:] - qy[..., :-1]) / dy, zeros + [(1, 1)])
+    return tc + dt * (acc / cp)
+
+
+def _window_pipeline(T_hbm, scratch, sems, *, nx, B):
+    """Double-buffered HBM->VMEM window fetch across SEQUENTIAL grid
+    programs: program i starts the DMA of window i+1 into the other buffer
+    slot before waiting on its own, so the next window's reads ride under
+    this window's compute. Window g covers ``[clip(g*B-1, 0, nx-(B+2)),
+    +B+2)`` along axis 0 (uniform size; clamped at the global edges). The
+    grid MUST run in order — callers pass ``dimension_semantics=
+    ("arbitrary",)``. Returns ``(window_ref, l0)`` where ``window_ref`` is
+    this program's (B+2)-window and ``l0`` is the window index of global
+    position ``i*B``."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    i = pl.program_id(0)
+    nprog = pl.num_programs(0)
+
+    def wstart(g):
+        return jnp.clip(g * B - 1, 0, nx - (B + 2))
+
+    def window_dma(slot, g):
+        return pltpu.make_async_copy(
+            T_hbm.at[pl.ds(wstart(g), B + 2)], scratch.at[slot],
+            sems.at[slot])
+
+    @pl.when(i == 0)
+    def _():
+        window_dma(0, 0).start()
+
+    @pl.when(i + 1 < nprog)
+    def _():
+        window_dma((i + 1) % 2, i + 1).start()
+
+    slot = i % 2
+    window_dma(slot, i).wait()
+    return scratch.at[slot], i * B - wstart(i)
+
+
+def _sequential_grid_params(interpret):
+    """pallas_call kwargs forcing in-order grid execution (required by the
+    cross-program DMA handoff of `_window_pipeline`)."""
+    if interpret:
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+
+    return {"compiler_params": pltpu.CompilerParams(
+        dimension_semantics=("arbitrary",))}
+
+
+def _mp_kernel(T_hbm, Cp_ref, out_ref, scratch, sems, *,
+               lam, dt, dx, dy, dz, nx, P, fuse):
     """Compute P output planes from a (P+2)-plane VMEM window of T.
 
     The window is DMA'd once per program, so interior T planes are read
     ~(1+2/P)x instead of the 3x of the plane-per-program kernel's three
-    BlockSpec streams — the stencil's dominant HBM term. z/y halo edits are
-    in-plane selects like `_plane_halo_kernel`; x halo planes (if fused) are
-    NOT handled here — `diffusion3d_step_halo_pallas_mp` patches them with
-    the in-place dim-0 halo write afterwards.
+    BlockSpec streams — the stencil's dominant HBM term. The window DMA is
+    DOUBLE-BUFFERED across grid programs (program i starts the fetch of
+    window i+1 before computing window i, the standard overlap pattern), so
+    the HBM reads of the next window ride under this window's VPU work just
+    like the auto-pipelined Cp/out streams; the grid must therefore execute
+    sequentially ("arbitrary" dimension semantics, set by the caller).
+    z/y halo edits are in-plane selects like `_plane_halo_kernel`; x halo
+    planes (if fused) are NOT handled here —
+    `diffusion3d_step_halo_pallas_mp` patches them with the in-place dim-0
+    halo write afterwards.
     """
     import jax.numpy as jnp
     from jax import lax
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
-    P = _MP_PLANES
     fuse_x, fuse_y, fuse_z = fuse
-    i = pl.program_id(0)
-    g0 = i * P                                   # first output plane
-    start = jnp.clip(g0 - 1, 0, nx - (P + 2))    # window start (uniform size)
-    cp_dma = pltpu.make_async_copy(T_hbm.at[pl.ds(start, P + 2)], scratch, sem)
-    cp_dma.start()
-    cp_dma.wait()
-    l0 = g0 - start                              # window index of plane g0
+    win, l0 = _window_pipeline(T_hbm, scratch, sems, nx=nx, B=P)
+    g0 = pl.program_id(0) * P                    # first output plane
 
     ny, nz = out_ref.shape[1:]
     row = lax.broadcasted_iota(jnp.int32, (ny, nz), 0)
@@ -448,9 +534,9 @@ def _mp_kernel(T_hbm, Cp_ref, out_ref, scratch, sem, *,
     for j in range(P):
         g = g0 + j
         l = l0 + j
-        tc = scratch[pl.ds(l, 1)][0]
-        tm = scratch[pl.ds(jnp.maximum(l - 1, 0), 1)][0]      # clamps at g==0
-        tp = scratch[pl.ds(jnp.minimum(l + 1, P + 1), 1)][0]  # ... at g==nx-1
+        tc = win[pl.ds(l, 1)][0]
+        tm = win[pl.ds(jnp.maximum(l - 1, 0), 1)][0]      # clamps at g==0
+        tp = win[pl.ds(jnp.minimum(l + 1, P + 1), 1)][0]  # ... at g==nx-1
         upd = _stencil_plane(tm, tc, tp, Cp_ref[j],
                              lam=lam, dt=dt, dx=dx, dy=dy, dz=dz)
         u = jnp.where(interior_yz & (g > 0) & (g < nx - 1), upd, tc)
@@ -476,11 +562,11 @@ def diffusion3d_step_halo_pallas_mp(T, Cp, *, lam, dt, dx, dy, dz, fuse,
     from jax.experimental.pallas import tpu as pltpu
 
     nx, ny, nz = T.shape
-    P = _MP_PLANES
+    P = mp_planes(T)
     blk = (P, ny, nz)
     dtp = T.dtype.type
     consts = dict(lam=dtp(lam), dt=dtp(dt), dx=dtp(dx), dy=dtp(dy), dz=dtp(dz))
-    kernel = partial(_mp_kernel, nx=nx,
+    kernel = partial(_mp_kernel, nx=nx, P=P,
                      fuse=tuple(bool(f) for f in fuse), **consts)
 
     try:
@@ -488,6 +574,7 @@ def diffusion3d_step_halo_pallas_mp(T, Cp, *, lam, dt, dx, dy, dz, fuse,
     except (AttributeError, TypeError):
         out_shape = jax.ShapeDtypeStruct(T.shape, T.dtype)
 
+    kwargs = _sequential_grid_params(interpret)
     U = pl.pallas_call(
         kernel,
         grid=(nx // P,),
@@ -497,9 +584,10 @@ def diffusion3d_step_halo_pallas_mp(T, Cp, *, lam, dt, dx, dy, dz, fuse,
         ],
         out_specs=pl.BlockSpec(blk, lambda i: (i, 0, 0)),
         out_shape=out_shape,
-        scratch_shapes=[pltpu.VMEM((P + 2, ny, nz), T.dtype),
-                        pltpu.SemaphoreType.DMA],
+        scratch_shapes=[pltpu.VMEM((2, P + 2, ny, nz), T.dtype),
+                        pltpu.SemaphoreType.DMA((2,))],
         interpret=interpret,
+        **kwargs,
     )(T, Cp)
 
     if not fuse[0]:
@@ -530,3 +618,139 @@ def diffusion3d_step_halo_pallas_mp(T, Cp, *, lam, dt, dx, dy, dz, fuse,
 
     return halo_write_inplace(U, patch(nx - 2), patch(1), dim=0, hw=1,
                               interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# 2-D fused step + exchange (BASELINE config 2): row strips through a
+# double-buffered VMEM window, same structure as the 3-D multi-plane path.
+# ---------------------------------------------------------------------------
+
+_STRIP2D_CANDIDATES = (256, 128, 64, 32, 16, 8)
+
+
+def strip_rows_2d(T):
+    """Rows per program R for the 2-D strip kernel, or None if unsupported.
+
+    Working set: double-buffered (R+2)-row T windows, double-buffered Cp in
+    and out blocks (2R rows each), plus the shifted-window temporaries of the
+    vectorized strip compute (~2(R+2)) and stencil temporaries — budgeted at
+    ~12R+8 rows."""
+    if T.ndim != 2:
+        return None
+    row_bytes = int(T.shape[1]) * T.dtype.itemsize
+    for R in _STRIP2D_CANDIDATES:
+        if T.shape[0] % R or T.shape[0] < 2 * R:
+            continue
+        if (12 * R + 8) * row_bytes <= _MP_VMEM_BUDGET:
+            return R
+    return None
+
+
+def _strip2d_kernel(*refs, nx, R, modes, lam, dt, dx, dy):
+    """Compute R output rows from an (R+2)-row VMEM window of T (DMA'd with
+    the same cross-program double buffering as `_mp_kernel`), then deliver
+    the received halo slabs: x whole rows first, then y lanes — the exchange
+    order for 2-D blocks (dims 0 then 1 of the z, x, y default; the y slabs
+    carry x's received corners via the slab pipeline's patching). The x-row
+    neighbors inside the window are built as edge-cloned shifts of the whole
+    window and sliced at the strip offset — edge clones only ever reach
+    globally-masked boundary rows (same soundness argument as
+    `_xla_update_slab`)."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    it = iter(refs)
+    T_hbm = next(it)
+    cp_ref = next(it)
+    rx_ref = next(it) if modes[0] else None       # (2, ny)
+    ry_ref = next(it) if modes[1] else None       # (R, 2) strip
+    o_ref = refs[-3]                              # outs precede scratches
+    scratch = refs[-2]
+    sems = refs[-1]
+
+    win, l0 = _window_pipeline(T_hbm, scratch, sems, nx=nx, B=R)
+    g0 = pl.program_id(0) * R
+    w = win[...]                                   # (R+2, ny)
+    tm_full = jnp.concatenate([w[:1], w[:-1]], axis=0)
+    tp_full = jnp.concatenate([w[1:], w[-1:]], axis=0)
+    tc = lax.dynamic_slice_in_dim(w, l0, R, axis=0)
+    tm = lax.dynamic_slice_in_dim(tm_full, l0, R, axis=0)
+    tp = lax.dynamic_slice_in_dim(tp_full, l0, R, axis=0)
+    upd = _stencil_row(tm, tc, tp, cp_ref[...], lam=lam, dt=dt, dx=dx, dy=dy)
+
+    ny = tc.shape[1]
+    g = g0 + lax.broadcasted_iota(jnp.int32, (R, ny), 0)   # global row index
+    col = lax.broadcasted_iota(jnp.int32, (R, ny), 1)
+    interior = (g > 0) & (g < nx - 1) & (col > 0) & (col < ny - 1)
+    u = jnp.where(interior, upd, tc)
+    if modes[0]:  # x rows first (received rows replace them entirely)
+        u = jnp.where(g == 0, rx_ref[0:1], u)
+        u = jnp.where(g == nx - 1, rx_ref[1:2], u)
+    if modes[1]:  # then y lanes (their slabs carry x's received corners)
+        u = jnp.where(col == 0, ry_ref[:, 0:1], u)
+        u = jnp.where(col == ny - 1, ry_ref[:, 1:2], u)
+    o_ref[...] = u
+
+
+def diffusion2d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
+                                     interpret=False):
+    """Fused 2-D diffusion step + halo exchange for arbitrary shardings —
+    the 2-D analog of `diffusion3d_step_exchange_pallas`: thin-slab send
+    computation in XLA -> the shared `exchange_recv_slabs` pipeline
+    (ppermutes / local swaps / PROC_NULL masking) -> one strip-pipelined
+    Pallas pass for update + delivery."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from .halo import exchange_recv_slabs
+
+    nx, ny = T.shape
+    R = strip_rows_2d(T)
+    dtp = T.dtype.type
+    consts = dict(lam=dtp(lam), dt=dtp(dt), dx=dtp(dx), dy=dtp(dy))
+
+    recvs = exchange_recv_slabs(
+        gg, T.shape, (1, 1), modes,
+        lambda dim, start, size: _xla_update_slab(T, Cp, dim, start, size,
+                                                  consts))
+
+    blk = (R, ny)
+    operands = [T, Cp]
+    in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),            # T: manual DMA window
+        pl.BlockSpec(blk, lambda i: (i, 0)),          # Cp
+    ]
+    if modes[0]:
+        rx = jnp.concatenate(recvs[0], axis=0)        # (2, ny)
+        operands.append(rx)
+        in_specs.append(pl.BlockSpec((2, ny), lambda i: (0, 0)))
+    if modes[1]:
+        ry = jnp.concatenate(recvs[1], axis=1)        # (nx, 2)
+        operands.append(ry)
+        in_specs.append(pl.BlockSpec((R, 2), lambda i: (i, 0)))
+
+    try:
+        vma = jax.typeof(T).vma
+        for op in operands[1:]:
+            vma = vma | jax.typeof(op).vma
+        out_shape = jax.ShapeDtypeStruct(T.shape, T.dtype, vma=vma)
+    except (AttributeError, TypeError):
+        out_shape = jax.ShapeDtypeStruct(T.shape, T.dtype)
+
+    kernel = partial(_strip2d_kernel, nx=nx, R=R,
+                     modes=tuple(bool(m) for m in modes), **consts)
+    kwargs = _sequential_grid_params(interpret)
+    return pl.pallas_call(
+        kernel,
+        grid=(nx // R,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(blk, lambda i: (i, 0)),
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((2, R + 2, ny), T.dtype),
+                        pltpu.SemaphoreType.DMA((2,))],
+        interpret=interpret,
+        **kwargs,
+    )(*operands)
