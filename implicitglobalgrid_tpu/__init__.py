@@ -40,7 +40,7 @@ from .parallel.topology import (
 )
 from .ops.halo import update_halo, local_update_halo, DEFAULT_DIMS_ORDER
 from .ops.overlap import hide_communication
-from .ops.gather import gather, gather_interior
+from .ops.gather import gather, gather_interior, gather_sub
 from .ops.alloc import zeros_g, ones_g, full_g, device_put_g, sharding_of
 from .ops.fields import Field, wrap_field, extract, local_shape_of, stacked_shape
 from .ops.stencil import d_xa, d_ya, d_za, d_xi, d_yi, d_zi, inn
@@ -59,7 +59,7 @@ __all__ = [
     "init_global_grid", "finalize_global_grid", "update_halo", "gather",
     "select_device", "nx_g", "ny_g", "nz_g", "x_g", "y_g", "z_g", "tic", "toc",
     # TPU-native extensions
-    "local_update_halo", "hide_communication", "gather_interior", "barrier",
+    "local_update_halo", "hide_communication", "gather_interior", "gather_sub", "barrier",
     "sync", "trace", "annotate",
     "zeros_g", "ones_g", "full_g", "device_put_g", "sharding_of",
     "Field", "wrap_field", "extract", "local_shape_of", "stacked_shape",

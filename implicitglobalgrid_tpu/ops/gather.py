@@ -22,7 +22,7 @@ from ..parallel.topology import check_initialized, global_grid
 from ..utils.exceptions import IncoherentArgumentError, InvalidArgumentError
 from .fields import local_shape_of
 
-__all__ = ["gather", "gather_interior"]
+__all__ = ["gather", "gather_interior", "gather_sub"]
 
 
 def _to_host(A) -> np.ndarray:
@@ -74,6 +74,72 @@ def gather(A, A_global=None, *, root: int = 0, layout: str | None = None):
         np.copyto(np.asarray(A_global), host)
         return A_global
     return host
+
+
+def gather_sub(A, box, A_global=None, *, root: int = 0,
+               layout: str | None = None):
+    """Gather only the shards whose Cartesian coordinates lie in ``box`` —
+    the analog of the reference's advanced overload gathering over an
+    EXPLICIT sub-communicator (`gather.jl:25-33`, where a caller-built comm
+    selects the participating ranks of a sub-grid).
+
+    ``box`` is a per-dimension sequence of ``(lo, hi)`` half-open coordinate
+    ranges (up to 3 entries; omitted/None entries mean the full axis). The
+    result on ``root`` is the stacked array of the selected shard block,
+    shape ``(hi-lo) * local_shape`` per grid dimension; other processes
+    return ``None``. ``A_global`` (numpy) receives the result in place like
+    `gather`.
+    """
+    import jax
+
+    check_initialized()
+    gg = global_grid()
+
+    loc = local_shape_of(A.shape, layout)
+    nd = len(loc)
+    box = list(box) + [None] * (3 - len(list(box)))
+    if any(b is not None for b in box[nd:]):
+        raise InvalidArgumentError(
+            f"gather_sub box selects dimension(s) beyond the array's rank "
+            f"({nd}-D): {tuple(box)}."
+        )
+    ranges = []
+    for d in range(nd):
+        D = int(gg.dims[d]) if d < 3 else 1
+        sel = box[d] if d < 3 else None
+        if sel is None:
+            ranges.append((0, D))
+            continue
+        lo, hi = (int(sel[0]), int(sel[1]))
+        if not (0 <= lo < hi <= D):
+            raise InvalidArgumentError(
+                f"gather_sub box along dimension {d} must satisfy "
+                f"0 <= lo < hi <= dims[{d}]={D}; got ({lo}, {hi})."
+            )
+        ranges.append((lo, hi))
+
+    # Slice the BOX off first — on a sharded jax.Array the slice stays
+    # shard-local, so the collective below moves only the selected block
+    # (O(box), like the reference sub-communicator gather), not the full
+    # global array. The slice + assembly are collective in multi-host runs:
+    # every process must reach them (same ordering rule as `gather`).
+    sl = tuple(
+        slice(ranges[d][0] * int(loc[d]), ranges[d][1] * int(loc[d]))
+        for d in range(nd)
+    )
+    host = _to_host(A[sl])
+    if jax.process_index() != root:
+        return None
+    sub = host
+    if A_global is not None:
+        if tuple(int(s) for s in A_global.shape) != sub.shape:
+            raise IncoherentArgumentError(
+                f"gather_sub: A_global shape {tuple(A_global.shape)} does "
+                f"not match the selected block shape {sub.shape}."
+            )
+        np.copyto(np.asarray(A_global), sub)
+        return A_global
+    return sub.copy()
 
 
 def gather_interior(A, *, root: int = 0, layout: str | None = None):

@@ -240,18 +240,72 @@ def finalize_global_grid(*, finalize_dist: bool = False) -> None:
     gc.collect()
 
 
-def _select_device():
-    """Device binding shim. The reference binds each MPI rank to its node-local
-    GPU (`select_device.jl:15-39`); with PJRT every addressable device is
-    already bound to this process, so this returns the first local device's id
-    (kept for API compatibility)."""
+def node_local_rank():
+    """(node-local rank, processes on this host, devices on this host) — the
+    analog of the reference's `MPI.Comm_split_type(COMM_TYPE_SHARED)` +
+    `Comm_rank` node grouping (`select_device.jl:26-32`).
+
+    COLLECTIVE in multi-process runs (like the reference's MPI call):
+    every process must call it, or the callers deadlock in the allgather.
+    Processes are grouped by hostname (gathered with a tiny
+    `process_allgather` — the shared-memory-communicator analog); the rank
+    is this process's index among co-hosted processes in `process_index`
+    order. Single-process runs return ``(0, 1, local device count)``
+    without any collective."""
     import jax
 
+    n_local = len(jax.local_devices())
+    if jax.process_count() == 1:
+        return 0, 1, n_local
+    import hashlib
+    import socket
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    # int32-safe hash: without jax_enable_x64 the allgather truncates int64
+    h = int.from_bytes(
+        hashlib.sha1(socket.gethostname().encode()).digest()[:4], "big",
+        signed=True)
+    row = np.array([h, n_local], dtype=np.int32)
+    allrows = np.asarray(multihost_utils.process_allgather(row))
+    mine = jax.process_index()
+    same = [i for i in range(allrows.shape[0]) if allrows[i, 0] == h]
+    me_l = same.index(mine)
+    dev_on_node = int(sum(allrows[i, 1] for i in same))
+    return me_l, len(same), dev_on_node
+
+
+def _select_device():
+    """Device binding (reference `_select_device`, `select_device.jl:15-39`).
+
+    The reference computes the node-local rank and binds that GPU, erroring
+    when a node hosts more ranks than devices. With PJRT each process's
+    devices are already bound at runtime init, so binding is a no-op — but
+    the node-grouping semantics and the oversubscription guard are kept:
+    more co-hosted controllers than devices on the host is a config error
+    (unrepresentable in healthy PJRT deployments, where every process owns
+    at least one device — the check guards degenerate runtimes)."""
+    import jax
+
+    me_l, n_procs_node, dev_on_node = node_local_rank()
+    if n_procs_node > dev_on_node:
+        raise IncoherentArgumentError(
+            f"This host runs {n_procs_node} processes but only "
+            f"{dev_on_node} device(s): it is not possible to run more "
+            "processes per node than there are devices on it (reference "
+            "select_device.jl:28)."
+        )
     return jax.local_devices()[0].id
 
 
 def select_device() -> int:
-    """Return the device id bound to this process (API-parity shim of the
-    reference `select_device`, `select_device.jl:15`)."""
+    """Return the device id bound to this process after the node-local
+    oversubscription check (reference `select_device`, `select_device.jl:15`).
+
+    COLLECTIVE in multi-process runs — every process must call it together,
+    exactly like the reference's `MPI.Comm_split_type` inside
+    `_select_device` (`select_device.jl:26`). `init_global_grid` calls it
+    symmetrically on every process."""
     top.check_initialized()
     return _select_device()

@@ -71,3 +71,44 @@ def test_gather_interior_staggered():
     GI = igg.gather_interior(Vx)
     assert GI.shape == (igg.nx_g(Vx), igg.ny_g(), igg.nz_g()) == (9, 8, 8)
     assert np.all(GI == 7.0)
+
+
+def test_gather_sub_block():
+    """gather_sub selects the shard block of a coordinate box (the analog of
+    the reference's explicit sub-communicator overload, `gather.jl:25-33`)."""
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, quiet=True)
+    P = _encoded()
+    full = np.asarray(P)
+    # one shard
+    S = igg.gather_sub(P, ((0, 1), (1, 2), (0, 1)))
+    assert S.shape == (5, 5, 5)
+    assert np.array_equal(S, full[0:5, 5:10, 0:5])
+    # a 2x1x2 sub-grid; None selects the full axis
+    S = igg.gather_sub(P, (None, (0, 1), (0, 2)))
+    assert S.shape == (10, 5, 10)
+    assert np.array_equal(S, full[:, 0:5, :])
+    # in-place form + shape check
+    out = np.empty((10, 5, 10), np.float32)
+    r = igg.gather_sub(P, (None, (0, 1), None),
+                       out.astype(np.asarray(P).dtype))
+    assert np.array_equal(np.asarray(r), full[:, 0:5, :])
+    with pytest.raises(IncoherentArgumentError):
+        igg.gather_sub(P, (None, (0, 1), None), np.empty((3, 3, 3)))
+    # invalid boxes
+    from implicitglobalgrid_tpu.utils.exceptions import InvalidArgumentError
+    with pytest.raises(InvalidArgumentError):
+        igg.gather_sub(P, ((0, 3), None, None))
+    with pytest.raises(InvalidArgumentError):
+        igg.gather_sub(P, ((1, 1), None, None))
+
+
+def test_gather_sub_extra_box_dim_rejected():
+    """A box entry beyond the array's rank is a typo, not a no-op."""
+    from implicitglobalgrid_tpu.utils.exceptions import InvalidArgumentError
+
+    igg.init_global_grid(8, 8, 1, dimx=2, dimy=2, dimz=1, quiet=True)
+    A = igg.ones_g((8, 8), np.float32)
+    with pytest.raises(InvalidArgumentError):
+        igg.gather_sub(A, ((0, 1), (0, 1), (0, 1)))
+    S = igg.gather_sub(A, ((0, 1), (0, 2)))
+    assert S.shape == (8, 16)

@@ -28,7 +28,9 @@ _CHILD = textwrap.dedent("""
     import os, sys
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     dcn = sys.argv[4] if len(sys.argv) > 4 else ""
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    ndev = int(sys.argv[5]) if len(sys.argv) > 5 else 4
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={ndev}")
     if dcn:
         os.environ["IGG_TPU_DCN_AXES"] = dcn
     import jax
@@ -50,6 +52,14 @@ _CHILD = textwrap.dedent("""
         for idx in np.ndindex(2, 2, 2):
             assert mesh.devices[idx].process_index == idx[2], (idx,)
         expect_coords = (0, 0, 0) if pid == 0 else (0, 0, 1)
+    elif dcn == "y,z":
+        # two DCN axes, 4 granules: _dcn_factorization gives dcn=(1,2,2),
+        # ici=(2,1,1) — granule g owns the (y, z) = (g//2, g%2) block,
+        # spanning the full x axis intra-process; only y/z boundary
+        # permutes cross the "DCN"
+        for idx in np.ndindex(2, 2, 2):
+            assert mesh.devices[idx].process_index == idx[1] * 2 + idx[2], (idx,)
+        expect_coords = (0, pid // 2, pid % 2)
     else:
         # plain order: process 1's first device is mesh position (1,0,0)
         expect_coords = (0, 0, 0) if pid == 0 else (1, 0, 0)
@@ -80,6 +90,22 @@ _CHILD = textwrap.dedent("""
     igg.tic()
     t = igg.toc(sync_on=res)
     assert t >= 0.0
+
+    # node-local grouping (Comm_split_type analog): all children share this
+    # host, so the rank must be pid and the device pool the full mesh
+    from implicitglobalgrid_tpu.parallel.grid import node_local_rank
+    me_l, nprocs_node, dev_node = node_local_rank()
+    assert me_l == pid and nprocs_node == nproc, (me_l, nprocs_node)
+    assert dev_node == 8
+    assert igg.select_device() >= 0
+
+    # sub-communicator gather: root-coordinates shard only
+    sub = igg.gather_sub(res, ((0, 1), (0, 1), (0, 1)), root=0)
+    if pid == 0:
+        assert np.array_equal(np.asarray(sub), enc[0:5, 0:5, 0:5])
+    else:
+        assert sub is None
+
     igg.finalize_global_grid()
     print(f"MP_OK {pid}", flush=True)
 """)
@@ -93,8 +119,7 @@ def _free_port():
     return port
 
 
-@pytest.mark.parametrize("dcn", ["", "z"])
-def test_two_process_distributed_run(tmp_path, dcn):
+def _run_children(tmp_path, nproc, dcn, ndev, timeout=240):
     script = tmp_path / "child.py"
     script.write_text(_CHILD)
     port = _free_port()
@@ -104,16 +129,17 @@ def test_two_process_distributed_run(tmp_path, dcn):
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(pid), "2", str(port), dcn],
+            [sys.executable, str(script), str(pid), str(nproc), str(port),
+             dcn, str(ndev)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd="/root/repo",
         )
-        for pid in range(2)
+        for pid in range(nproc)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     finally:
         for p in procs:
@@ -122,3 +148,16 @@ def test_two_process_distributed_run(tmp_path, dcn):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out[-3000:]}"
         assert f"MP_OK {pid}" in out
+
+
+@pytest.mark.parametrize("dcn", ["", "z"])
+def test_two_process_distributed_run(tmp_path, dcn):
+    _run_children(tmp_path, 2, dcn, 4)
+
+
+def test_four_process_two_dcn_axes(tmp_path):
+    """4 controllers x 2 devices over TWO DCN axes (y, z): exercises the
+    multi-axis branch of `_dcn_factorization` (balanced (1,2,2) granule
+    layout) end-to-end — block layout asserted per device, halo restoration
+    through x (intra-granule) and y/z (cross-granule) exchanges."""
+    _run_children(tmp_path, 4, "y,z", 2, timeout=300)

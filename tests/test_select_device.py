@@ -66,3 +66,15 @@ def test_device_type_none_runs_on_cpu():
     )
     assert igg.global_grid().device_type in ("none", "cpu")
     assert igg.select_device() >= 0
+
+
+def test_node_local_rank_single_process():
+    """Single-process node grouping is trivial (the Comm_split_type analog,
+    reference `select_device.jl:26-32`): rank 0 of 1, all local devices."""
+    import jax
+
+    from implicitglobalgrid_tpu.parallel.grid import node_local_rank
+
+    me_l, nprocs_node, dev_node = node_local_rank()
+    assert me_l == 0 and nprocs_node == 1
+    assert dev_node == len(jax.local_devices())
