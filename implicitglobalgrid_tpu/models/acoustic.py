@@ -72,11 +72,29 @@ def init_acoustic3d(*, rho=1.0, K=1.0, lx=10.0, ly=10.0, lz=10.0,
         rho=rho, K=K, dt=dt, dx=dx, dy=dy, dz=dz, overlap=overlap)
 
 
-def acoustic_step_local(state, p: AcousticParams):
-    """One leapfrog step on LOCAL blocks (inside shard_map)."""
+def acoustic_step_local(state, p: AcousticParams, impl: str = "xla"):
+    """One leapfrog step on LOCAL blocks (inside shard_map).
+
+    ``impl``: "xla" (broadcast updates + per-field exchange) or "pallas"
+    (ONE fused Pallas pass doing both updates and the full 4-field
+    exchange — `ops/pallas_wave.py`; "pallas_interpret" for CPU tests)."""
     from jax import lax
 
     P, Vx, Vy, Vz = state
+    if impl.startswith("pallas"):
+        from ..ops.pallas_wave import (
+            acoustic_step_exchange_pallas, wave_exchange_modes,
+        )
+
+        gg = global_grid()
+        modes = wave_exchange_modes(
+            gg, (P.shape, Vx.shape, Vy.shape, Vz.shape))
+        if modes is not None:
+            return acoustic_step_exchange_pallas(
+                state, gg, modes, rho=p.rho, K=p.K, dt=p.dt,
+                dx=p.dx, dy=p.dy, dz=p.dz,
+                interpret=impl == "pallas_interpret")
+        # ineligible config: fall through to the XLA formulation
 
     # velocity update on interior faces: face i sits between cells i-1, i
     def dP(A, d):
@@ -103,12 +121,24 @@ def acoustic_step_local(state, p: AcousticParams):
     return (P, Vx, Vy, Vz)
 
 
-def make_acoustic_run(p: AcousticParams, nt_chunk: int):
+def _resolve_impl(impl):
+    from .common import resolve_pallas_impl
+
+    return resolve_pallas_impl(impl)
+
+
+def make_acoustic_run(p: AcousticParams, nt_chunk: int,
+                      impl: str | None = None):
+    impl = _resolve_impl(impl)
     return make_state_runner(
-        lambda s: acoustic_step_local(s, p), (3, 3, 3, 3),
-        nt_chunk=nt_chunk, key=("acoustic3d", p),
+        lambda s: acoustic_step_local(s, p, impl), (3, 3, 3, 3),
+        nt_chunk=nt_chunk, key=("acoustic3d", p, impl),
+        check_vma=False if impl.startswith("pallas") else None,
     )
 
 
-def run_acoustic(state, p: AcousticParams, nt: int, *, nt_chunk: int = 100):
-    return run_chunked(lambda c: make_acoustic_run(p, c), state, nt, nt_chunk)
+def run_acoustic(state, p: AcousticParams, nt: int, *, nt_chunk: int = 100,
+                 impl: str | None = None):
+    impl = _resolve_impl(impl)
+    return run_chunked(lambda c: make_acoustic_run(p, c, impl), state, nt,
+                       nt_chunk)

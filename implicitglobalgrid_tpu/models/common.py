@@ -12,9 +12,26 @@ from __future__ import annotations
 from ..ops.fields import field_partition_spec
 from ..parallel.topology import check_initialized, global_grid
 
-__all__ = ["make_state_runner", "run_chunked", "default_check_vma"]
+__all__ = ["make_state_runner", "run_chunked", "default_check_vma",
+           "resolve_pallas_impl"]
 
 _runner_cache: dict = {}
+
+
+def resolve_pallas_impl(impl, eligible: bool = True):
+    """Shared default-impl rule for every model family: an explicit ``impl``
+    wins; otherwise the Pallas kernel tier is the default on TPU grids with
+    all IGG_USE_PALLAS flags on (the reference's per-dim copy-kernel toggle,
+    `init_global_grid.jl:60,71-75`) when the model has a kernel for this
+    configuration (``eligible``), else the XLA path."""
+    if impl is not None:
+        return impl
+    from ..parallel.topology import global_grid
+
+    gg = global_grid()
+    if eligible and bool(gg.use_pallas.all()) and gg.device_type == "tpu":
+        return "pallas"
+    return "xla"
 
 
 def default_check_vma(step_uses_pallas: bool = False) -> bool:

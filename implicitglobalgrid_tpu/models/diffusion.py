@@ -222,13 +222,9 @@ def _resolve_impl(impl, ndim=3):
     other ndims resolve to the XLA path so check_vma stays on for them. The
     fused step kernel covers all dims at once, so ANY explicit per-dim
     opt-out (e.g. IGG_USE_PALLAS_DIMX=0) falls back to the XLA path."""
-    if impl is not None:
-        return impl
-    gg = global_grid()
-    if ndim in (2, 3) and bool(gg.use_pallas.all()) \
-            and gg.device_type == "tpu":
-        return "pallas"
-    return "xla"
+    from .common import resolve_pallas_impl
+
+    return resolve_pallas_impl(impl, eligible=ndim in (2, 3))
 
 
 def make_step(p: DiffusionParams, ndim: int = 3, impl: str | None = None):

@@ -97,6 +97,14 @@ def gather_sub(A, box, A_global=None, *, root: int = 0,
 
     loc = local_shape_of(A.shape, layout)
     nd = len(loc)
+    for d in range(min(nd, 3)):
+        if int(A.shape[d]) != int(gg.dims[d]) * int(loc[d]):
+            raise InvalidArgumentError(
+                "gather_sub requires a STACKED global array (dims * local "
+                f"size); got shape {tuple(A.shape)} (local along dimension "
+                f"{d}). The coordinate box selects shard blocks of the "
+                "stacked layout."
+            )
     box = list(box) + [None] * (3 - len(list(box)))
     if any(b is not None for b in box[nd:]):
         raise InvalidArgumentError(

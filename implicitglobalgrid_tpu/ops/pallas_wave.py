@@ -1,0 +1,390 @@
+"""Fused Pallas pass for the acoustic leapfrog step — the kernel tier for
+the staggered-grid wave model (BASELINE config 4).
+
+The XLA formulation of one acoustic step (`models/acoustic.py`) costs ~5
+array passes over 4 fields: the three velocity updates, their 3-field halo
+exchange, the pressure update, and its exchange. This module fuses the WHOLE
+step — both updates AND both exchanges — into one plane-pipelined Pallas
+pass over all four fields (the staggered-field analog of
+`pallas_stencil.diffusion3d_step_exchange_pallas`, and of the reference's
+kernel tier serving every field type, `CUDAExt/update_halo.jl:143-146`).
+
+Why one pass is semantically sound (halowidth-1 fields):
+
+- The velocity update touches only INTERIOR faces and reads only P — no
+  received values needed.
+- Velocity SEND slabs sit >= 1 face inside the block, so they are computed
+  from local P alone (`_xla_update_slab`-style thin-slab computes); the
+  received slabs come from the shared `exchange_recv_slabs` pipeline
+  (ppermutes / local swaps / PROC_NULL masking / corner patching).
+- The pressure update needs post-exchange V faces ONLY at cells that are
+  themselves P halo cells: every surviving cell of every P send slab is
+  interior in the cross dimensions (its cross-dim edge cells are either
+  patched from earlier dims' recvs before sending or overwritten by later
+  dims' recvs after delivery — the z, x, y order), and interior cells read
+  only locally-updated faces. At PROC_NULL edges the kept faces are the
+  un-updated boundary faces — exactly the local raw values. Hence the P
+  send slabs are computed from LOCAL updated V values only, and the fused
+  pass reproduces the sequential update->exchange->update->exchange result.
+
+Delivery order inside the kernel is the reference's z, x, y per field; Vx's
+x-extent (nx+1 planes) exceeds the grid (nx programs), so its two x halo
+planes are written afterwards by the in-place dim-0 kernel with slabs whose
+y rows are patched from the y recvs (preserving the x-before-y order).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+__all__ = ["wave_exchange_modes", "acoustic_step_exchange_pallas"]
+
+
+def wave_exchange_modes(gg, shapes):
+    """Per-field participation modes for the fused acoustic step, or None.
+
+    ``shapes`` = (P, Vx, Vy, Vz) local shapes. Eligible when the shapes
+    follow the model's staggering pattern (faces on +1 axes), every grid
+    halowidth is 1 (the delivery selects hardwire width-1 halos), and at
+    least one (field, dim) exchanges. Returns a dict
+    ``{"P": modes, "Vx": modes, ...}`` of 3-tuples."""
+    from .halo import _dim_exchanges
+
+    sp, sx, sy, sz = (tuple(int(v) for v in s) for s in shapes)
+    if len(sp) != 3 or sp[0] < 3:
+        return None
+    if sp != tuple(int(n) for n in gg.nxyz):
+        return None
+    nx, ny, nz = sp
+    if sx != (nx + 1, ny, nz) or sy != (nx, ny + 1, nz) \
+            or sz != (nx, ny, nz + 1):
+        return None
+    if any(int(h) != 1 for h in gg.halowidths):
+        return None
+    hws = (1, 1, 1)
+    out = {}
+    for name, s in (("P", sp), ("Vx", sx), ("Vy", sy), ("Vz", sz)):
+        out[name] = tuple(_dim_exchanges(gg, s, hws, d) for d in range(3))
+    if not any(any(m) for m in out.values()):
+        return None
+    return out
+
+
+def _upd_vx_plane(Vx, P, f, c):
+    """Updated Vx face plane ``f`` (static index): interior faces get the
+    leapfrog P-gradient update, boundary faces (0, nx) keep their values
+    (reference `Vx.at[1:-1].add`, `models/acoustic.py`)."""
+    from jax import lax
+
+    nx1 = Vx.shape[0]
+    v = lax.slice_in_dim(Vx, f, f + 1, axis=0)
+    if f < 1 or f > nx1 - 2:
+        return v
+    pm = lax.slice_in_dim(P, f - 1, f, axis=0)
+    pc = lax.slice_in_dim(P, f, f + 1, axis=0)
+    return v + c * (pc - pm)
+
+
+def _upd_v_inplane(V, P, axis, c):
+    """All ``axis``-faces of V updated from P within a slab spanning the
+    full ``axis`` extent: interior faces via the padded P difference (the
+    pad zeroes the update at boundary faces, keeping them raw)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = P.shape[axis]
+    d = (lax.slice_in_dim(P, 1, n, axis=axis)
+         - lax.slice_in_dim(P, 0, n - 1, axis=axis))
+    pads = [(0, 0)] * P.ndim
+    pads[axis] = (1, 1)
+    return V + c * jnp.pad(d, pads)
+
+
+def _slab(A, dim, start):
+    from jax import lax
+
+    return lax.slice_in_dim(A, start, start + 1, axis=dim)
+
+
+def _make_v_get_slab(V, P, axis, c):
+    """get_slab for a velocity field staggered along ``axis``: returns the
+    POST-update values of the width-1 slab at ``start`` along ``dim``."""
+    def get(dim, start, size):
+        assert size == 1
+        if dim == axis:
+            if axis == 0:
+                return _upd_vx_plane(V, P, start, c)
+            Vs = _slab(V, dim, start)  # one face layer; needs P start-1,start
+            if start < 1 or start > V.shape[dim] - 2:
+                return Vs
+            return Vs + c * (_slab(P, dim, start) - _slab(P, dim, start - 1))
+        # slab across the staggered axis: update all its axis-faces locally
+        return _upd_v_inplane(_slab(V, dim, start), _slab(P, dim, start),
+                              axis, c)
+    return get
+
+
+def _make_p_get_slab(P, Vx, Vy, Vz, cx, cy, cz, dtK, dx, dy, dz):
+    """get_slab for P: POST-update pressure on the width-1 slab, computed
+    from LOCALLY updated faces only (see module docstring for why received
+    faces are never needed on surviving cells)."""
+    from jax import lax
+
+    def div_term(Vn, axis, dd):
+        n = Vn.shape[axis]
+        return (lax.slice_in_dim(Vn, 1, n, axis=axis)
+                - lax.slice_in_dim(Vn, 0, n - 1, axis=axis)) / dd
+
+    def get(dim, start, size):
+        assert size == 1
+        Ps = _slab(P, dim, start)
+        if dim == 0:
+            vxa = _upd_vx_plane(Vx, P, start, cx)
+            vxb = _upd_vx_plane(Vx, P, start + 1, cx)
+            divx = (vxb - vxa) / dx
+            vyn = _upd_v_inplane(_slab(Vy, 0, start), Ps, 1, cy)
+            vzn = _upd_v_inplane(_slab(Vz, 0, start), Ps, 2, cz)
+            return Ps - dtK * (divx + div_term(vyn, 1, dy)
+                               + div_term(vzn, 2, dz))
+        axis, c, dd, Vs = ((1, cy, dy, Vy) if dim == 1 else (2, cz, dz, Vz))
+
+        def vface(g):  # updated face layer g of the staggered-axis field
+            Vf = _slab(Vs, dim, g)
+            if g < 1 or g > Vs.shape[dim] - 2:
+                return Vf
+            return Vf + c * (_slab(P, dim, g) - _slab(P, dim, g - 1))
+
+        divs = (vface(start + 1) - vface(start)) / dd
+        vxn = _upd_v_inplane(_slab(Vx, dim, start), Ps, 0, cx)
+        oa, oc, od, oV = ((2, cz, dz, Vz) if dim == 1 else (1, cy, dy, Vy))
+        von = _upd_v_inplane(_slab(oV, dim, start), Ps, oa, oc)
+        return Ps - dtK * (div_term(vxn, 0, dx) + divs
+                           + div_term(von, oa, od))
+    return get
+
+
+def _deliver(u, i, nx_planes, modes, rx, ry, rz, row_hi, col_hi):
+    """Apply a field's received halo slabs to its computed plane ``u``, in
+    the reference order z, x, y. ``rx`` is None for fields whose x planes
+    are written post-kernel (Vx). ``row_hi``/``col_hi`` are the last
+    row/lane indices of the plane (staggered extents differ)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rows, cols = u.shape
+    row = lax.broadcasted_iota(jnp.int32, (rows, cols), 0)
+    col = lax.broadcasted_iota(jnp.int32, (rows, cols), 1)
+    if modes[2]:
+        u = jnp.where(col == 0, rz[:, 0:1], u)
+        u = jnp.where(col == col_hi, rz[:, 1:2], u)
+    if modes[0] and rx is not None:
+        u = jnp.where(i == 0, rx[0], jnp.where(i == nx_planes - 1, rx[1], u))
+    if modes[1]:
+        u = jnp.where(row == 0, ry[0:1, :], u)
+        u = jnp.where(row == row_hi, ry[1:2, :], u)
+    return u
+
+
+def _wave_kernel(*refs, nx, modes, cx, cy, cz, dtK, dx, dy, dz):
+    """One x-plane of the fused step: velocity updates, velocity halo
+    delivery, pressure update from the delivered faces, pressure halo
+    delivery. See module docstring for the ordering argument."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    it = iter(refs)
+    p_m, p_c, p_p = (next(it)[0] for _ in range(3))
+    vx_c, vx_p = (next(it)[0] for _ in range(2))
+    vy_c = next(it)[0]
+    vz_c = next(it)[0]
+
+    def take(field, kinds):
+        got = {}
+        for k in kinds:
+            if not modes[field][{"x": 0, "y": 1, "z": 2}[k]]:
+                got[k] = None
+                continue
+            ref = next(it)
+            # x recv blocks are (2, rows, cols) plane pairs — keep both
+            # planes; y/z recv blocks are (1, ...) streams — drop the axis.
+            got[k] = ref[...] if k == "x" else ref[0]
+        return got
+    rP = take("P", ("x", "y", "z"))
+    rVx = take("Vx", ("y", "z"))
+    rVy = take("Vy", ("x", "y", "z"))
+    rVz = take("Vz", ("x", "y", "z"))
+    oP, oVx, oVy, oVz = refs[-4:]
+
+    i = pl.program_id(0)
+    ny, nz = p_c.shape
+
+    # --- velocity updates (interior faces only; x-masks are dynamic in i)
+    vx = jnp.where((i >= 1) & (i <= nx - 1), vx_c + cx * (p_c - p_m), vx_c)
+    vxp = jnp.where(i + 1 <= nx - 1, vx_p + cx * (p_p - p_c), vx_p)
+    dyv = p_c[1:, :] - p_c[:-1, :]
+    vy = vy_c + cy * jnp.pad(dyv, ((1, 1), (0, 0)))
+    dzv = p_c[:, 1:] - p_c[:, :-1]
+    vz = vz_c + cz * jnp.pad(dzv, ((0, 0), (1, 1)))
+
+    # --- velocity halo delivery (z, x, y; Vx's x planes are post-kernel)
+    vx = _deliver(vx, i, nx, modes["Vx"], None, rVx["y"], rVx["z"],
+                  ny - 1, nz - 1)
+    vy = _deliver(vy, i, nx, modes["Vy"], rVy["x"], rVy["y"], rVy["z"],
+                  ny, nz - 1)
+    vz = _deliver(vz, i, nx, modes["Vz"], rVz["x"], rVz["y"], rVz["z"],
+                  ny - 1, nz)
+
+    # --- pressure update from the DELIVERED faces (vxp undelivered: its
+    # values only reach P halo cells, where they match the sequential
+    # semantics — see module docstring)
+    divx = (vxp - vx) / dx
+    divy = (vy[1:, :] - vy[:-1, :]) / dy
+    divz = (vz[:, 1:] - vz[:, :-1]) / dz
+    p_new = p_c - dtK * (divx + divy + divz)
+    p_new = _deliver(p_new, i, nx, modes["P"], rP["x"], rP["y"], rP["z"],
+                     ny - 1, nz - 1)
+
+    oP[0] = p_new
+    oVx[0] = vx
+    oVy[0] = vy
+    oVz[0] = vz
+
+
+def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
+                                  dx, dy, dz, interpret=False):
+    """One fused acoustic step (updates + full exchange of all four fields)
+    for arbitrary shardings. ``modes`` from `wave_exchange_modes`."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    from .halo import exchange_recv_slabs
+
+    P, Vx, Vy, Vz = state
+    nx, ny, nz = P.shape
+    dtp = P.dtype.type
+    cx, cy, cz = (dtp(-dt / rho / d) for d in (dx, dy, dz))
+    dtK = dtp(dt * K)
+    dxp, dyp, dzp = (dtp(v) for v in (dx, dy, dz))
+    hws = (1, 1, 1)
+
+    recvs = {}
+    recvs["Vx"] = exchange_recv_slabs(gg, Vx.shape, hws, modes["Vx"],
+                                      _make_v_get_slab(Vx, P, 0, cx))
+    recvs["Vy"] = exchange_recv_slabs(gg, Vy.shape, hws, modes["Vy"],
+                                      _make_v_get_slab(Vy, P, 1, cy))
+    recvs["Vz"] = exchange_recv_slabs(gg, Vz.shape, hws, modes["Vz"],
+                                      _make_v_get_slab(Vz, P, 2, cz))
+    recvs["P"] = exchange_recv_slabs(
+        gg, P.shape, hws, modes["P"],
+        _make_p_get_slab(P, Vx, Vy, Vz, cx, cy, cz, dtK, dxp, dyp, dzp))
+
+    def spec(shape, index_map):
+        return pl.BlockSpec(shape, index_map)
+
+    operands = [P, P, P, Vx, Vx, Vy, Vz]
+    in_specs = [
+        spec((1, ny, nz), lambda i: (jnp.maximum(i - 1, 0), 0, 0)),
+        spec((1, ny, nz), lambda i: (i, 0, 0)),
+        spec((1, ny, nz), lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
+        spec((1, ny, nz), lambda i: (i, 0, 0)),
+        spec((1, ny, nz), lambda i: (i + 1, 0, 0)),
+        spec((1, ny + 1, nz), lambda i: (i, 0, 0)),
+        spec((1, ny, nz + 1), lambda i: (i, 0, 0)),
+    ]
+
+    def add_recvs(field, kinds, shapes_specs):
+        for k, (cat, blk, imap) in zip(kinds, shapes_specs):
+            d = {"x": 0, "y": 1, "z": 2}[k]
+            if not modes[field][d]:
+                continue
+            rl, rr = recvs[field][d]
+            operands.append(jnp.concatenate([rl, rr], axis=cat))
+            in_specs.append(spec(blk, imap))
+
+    c0 = lambda i: (0, 0, 0)
+    ci = lambda i: (i, 0, 0)
+    add_recvs("P", ("x", "y", "z"), [
+        (0, (2, ny, nz), c0), (1, (1, 2, nz), ci), (2, (1, ny, 2), ci)])
+    add_recvs("Vx", ("y", "z"), [
+        (1, (1, 2, nz), ci), (2, (1, ny, 2), ci)])
+    add_recvs("Vy", ("x", "y", "z"), [
+        (0, (2, ny + 1, nz), c0), (1, (1, 2, nz), ci),
+        (2, (1, ny + 1, 2), ci)])
+    add_recvs("Vz", ("x", "y", "z"), [
+        (0, (2, ny, nz + 1), c0), (1, (1, 2, nz + 1), ci),
+        (2, (1, ny, 2), ci)])
+
+    def out_shape_of(a):
+        try:
+            vma = jax.typeof(a).vma
+            for op in operands:
+                vma = vma | jax.typeof(op).vma
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, vma=vma)
+        except (AttributeError, TypeError):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+    kernel = partial(
+        _wave_kernel, nx=nx,
+        modes={k: tuple(bool(b) for b in v) for k, v in modes.items()},
+        cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dxp, dy=dyp, dz=dzp)
+
+    Pn, Vxn, Vyn, Vzn = pl.pallas_call(
+        kernel,
+        grid=(nx,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, ny, nz), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, ny, nz), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, ny + 1, nz), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, ny, nz + 1), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[out_shape_of(P), out_shape_of(Vx), out_shape_of(Vy),
+                   out_shape_of(Vz)],
+        interpret=interpret,
+    )(*operands)
+
+    # The kernel wrote Vx planes 0..nx-1 of the (nx+1)-plane output; plane
+    # nx is ALWAYS written here (it would otherwise be uninitialized), and
+    # plane 0 is rewritten with its final value. Slab-level patching keeps
+    # the z, x, y order: the x recv slabs already carry z corners (pipeline
+    # patching); the y recvs' corner rows go on top.
+    from .pallas_halo import halo_write_inplace
+
+    def lane_patch(plane, xpos):
+        """z recvs applied to a raw Vx plane sliced at ``xpos``."""
+        if not modes["Vx"][2]:
+            return plane
+        zl, zr = recvs["Vx"][2]
+        zls = lax.slice_in_dim(zl, xpos, xpos + 1, axis=0)
+        zrs = lax.slice_in_dim(zr, xpos, xpos + 1, axis=0)
+        plane = lax.dynamic_update_slice_in_dim(plane, zls, 0, axis=2)
+        return lax.dynamic_update_slice_in_dim(
+            plane, zrs, plane.shape[2] - 1, axis=2)
+
+    def row_patch(plane, xpos):
+        """y recvs applied to a Vx plane sliced at ``xpos``."""
+        if not modes["Vx"][1]:
+            return plane
+        yl, yr = recvs["Vx"][1]
+        yls = lax.slice_in_dim(yl, xpos, xpos + 1, axis=0)
+        yrs = lax.slice_in_dim(yr, xpos, xpos + 1, axis=0)
+        plane = lax.dynamic_update_slice_in_dim(plane, yls, 0, axis=1)
+        return lax.dynamic_update_slice_in_dim(
+            plane, yrs, plane.shape[1] - 1, axis=1)
+
+    if modes["Vx"][0]:
+        rl, rr = recvs["Vx"][0]      # z corners already patched in-pipeline
+        plane0 = row_patch(rl, 0)
+        planeN = row_patch(rr, nx)
+    else:
+        # no x exchange: plane nx keeps its raw boundary values, with the
+        # z then y recvs applied; plane 0 is already final in the kernel
+        # output (delivered there).
+        planeN = row_patch(lane_patch(
+            lax.slice_in_dim(Vx, nx, nx + 1, axis=0), nx), nx)
+        plane0 = lax.slice_in_dim(Vxn, 0, 1, axis=0)
+    Vxn = halo_write_inplace(Vxn, plane0, planeN, dim=0, hw=1,
+                             interpret=interpret)
+    return (Pn, Vxn, Vyn, Vzn)

@@ -112,3 +112,14 @@ def test_gather_sub_extra_box_dim_rejected():
         igg.gather_sub(A, ((0, 1), (0, 1), (0, 1)))
     S = igg.gather_sub(A, ((0, 1), (0, 2)))
     assert S.shape == (8, 16)
+
+
+def test_gather_sub_rejects_local_layout():
+    """A local-layout array into gather_sub would silently clamp slices —
+    the box math is defined on the stacked layout only."""
+    from implicitglobalgrid_tpu.utils.exceptions import InvalidArgumentError
+
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, quiet=True)
+    with pytest.raises(InvalidArgumentError):
+        igg.gather_sub(np.zeros((5, 5, 5), np.float32), ((1, 2), None, None),
+                       layout="local")
