@@ -163,6 +163,46 @@ def _checks(interpret: bool):
     results.append(run("fused_step_exchange", check_step_exchange_fused))
     igg.finalize_global_grid()
 
+    # --- fused acoustic and Stokes passes (staggered multi-field tiers) ---
+    from implicitglobalgrid_tpu.models import (
+        init_acoustic3d, init_stokes3d, run_acoustic, run_stokes,
+    )
+
+    pal = "pallas_interpret" if interpret else "pallas"
+
+    def check_acoustic_fused():
+        igg.init_global_grid(32, 64, 256, periodx=1, periody=1, periodz=1,
+                             quiet=True)
+        try:
+            state, pa = init_acoustic3d(dtype=np.float32)
+            a = run_acoustic(state, pa, 2, nt_chunk=2, impl="xla")
+            b = run_acoustic(state, pa, 2, nt_chunk=2, impl=pal)
+            md = max(float(np.max(np.abs(np.asarray(igg.gather(x))
+                                         - np.asarray(igg.gather(y)))))
+                     for x, y in zip(a, b))
+            return md < 1e-5, f"max_abs_diff={md:.3e}"
+        finally:
+            igg.finalize_global_grid()
+
+    def check_stokes_fused():
+        igg.init_global_grid(32, 64, 256, quiet=True)
+        try:
+            state, pstk = init_stokes3d(dtype=np.float32)
+            a = run_stokes(state, pstk, 2, nt_chunk=2, impl="xla")
+            b = run_stokes(state, pstk, 2, nt_chunk=2, impl=pal)
+            md = 0.0
+            for x, y in zip(a, b):
+                gx = np.asarray(igg.gather(x))
+                gy = np.asarray(igg.gather(y))
+                scale = max(1.0, float(np.abs(gx).max()))
+                md = max(md, float(np.max(np.abs(gx - gy))) / scale)
+            return md < 1e-4, f"max_rel_diff={md:.3e}"
+        finally:
+            igg.finalize_global_grid()
+
+    results.append(run("acoustic_fused", check_acoustic_fused))
+    results.append(run("stokes_fused", check_stokes_fused))
+
     n_pass = sum(results)
     bench_util.emit({
         "metric": "pallas_checks_passed",

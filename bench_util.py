@@ -27,7 +27,7 @@ import time
 
 _CHILD_ENV = "IGG_BENCH_CHILD"
 _BACKOFFS = (5, 15, 30, 60)
-_ATTEMPT_TIMEOUT = 1800  # seconds per child attempt
+_ATTEMPT_TIMEOUT = 2400  # seconds per child attempt (the full-evidence bench runs 7 configs + the kernel checks)
 
 
 def device_fields() -> dict:

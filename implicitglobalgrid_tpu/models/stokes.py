@@ -75,9 +75,12 @@ def init_stokes3d(*, mu=1.0, lx=10.0, ly=10.0, lz=10.0, rhog_mag=1.0,
     Vx = zeros_g((nx + 1, ny, nz), dtype=dtype)
     Vy = zeros_g((nx, ny + 1, nz), dtype=dtype)
     Vz = zeros_g((nx, ny, nz + 1), dtype=dtype)
-    dVx = zeros_g((nx - 1, ny - 2, nz - 2), dtype=dtype)
-    dVy = zeros_g((nx - 2, ny - 1, nz - 2), dtype=dtype)
-    dVz = zeros_g((nx - 2, ny - 2, nz - 1), dtype=dtype)
+    # damped-momentum fields mirror the velocity shapes (only interior faces
+    # are ever nonzero) — face-aligned full-size arrays keep the Pallas
+    # kernel tier's plane mapping uniform across the state
+    dVx = zeros_g((nx + 1, ny, nz), dtype=dtype)
+    dVy = zeros_g((nx, ny + 1, nz), dtype=dtype)
+    dVz = zeros_g((nx, ny, nz + 1), dtype=dtype)
     state = (P, Vx, Vy, Vz, dVx, dVy, dVz, rhog)
     return state, StokesParams(mu=mu, dt_v=dt_v, dt_p=dt_p, damp=damp,
                                dx=dx, dy=dy, dz=dz)
@@ -125,30 +128,60 @@ def _stokes_terms(state, p: StokesParams):
     return Pn, divV, Rx, Ry, Rz
 
 
-def stokes_step_local(state, p: StokesParams):
-    """One damped PT iteration on LOCAL blocks (inside shard_map)."""
+def stokes_step_local(state, p: StokesParams, impl: str = "xla"):
+    """One damped PT iteration on LOCAL blocks (inside shard_map).
+
+    ``impl``: "xla", or "pallas" — ONE fused Pallas pass computing the
+    pressure/stress/momentum updates AND delivering the halo exchange of
+    (Vx, Vy, Vz, Pn) (`ops/pallas_stokes.py`; "pallas_interpret" on CPU)."""
     P, Vx, Vy, Vz, dVx, dVy, dVz, rhog = state
+    if impl.startswith("pallas"):
+        from ..ops.pallas_stokes import (
+            stokes_exchange_modes, stokes_step_exchange_pallas,
+        )
+
+        gg = global_grid()
+        modes = stokes_exchange_modes(gg, tuple(a.shape for a in state))
+        if modes is not None:
+            return stokes_step_exchange_pallas(
+                state, gg, modes, p, interpret=impl == "pallas_interpret")
+        # ineligible config: fall through to the XLA formulation
     Pn, divV, Rx, Ry, Rz = _stokes_terms(state, p)
-    dVx = p.damp * dVx + Rx
-    dVy = p.damp * dVy + Ry
-    dVz = p.damp * dVz + Rz
-    Vx = Vx.at[1:-1, 1:-1, 1:-1].add(p.dt_v * dVx)
-    Vy = Vy.at[1:-1, 1:-1, 1:-1].add(p.dt_v * dVy)
-    Vz = Vz.at[1:-1, 1:-1, 1:-1].add(p.dt_v * dVz)
+    ix = (slice(1, -1),) * 3
+    dVx_i = p.damp * dVx[ix] + Rx
+    dVy_i = p.damp * dVy[ix] + Ry
+    dVz_i = p.damp * dVz[ix] + Rz
+    dVx = dVx.at[ix].set(dVx_i)
+    dVy = dVy.at[ix].set(dVy_i)
+    dVz = dVz.at[ix].set(dVz_i)
+    Vx = Vx.at[ix].add(p.dt_v * dVx_i)
+    Vy = Vy.at[ix].add(p.dt_v * dVy_i)
+    Vz = Vz.at[ix].add(p.dt_v * dVz_i)
     Vx, Vy, Vz, Pn = local_update_halo(Vx, Vy, Vz, Pn)
     return (Pn, Vx, Vy, Vz, dVx, dVy, dVz, rhog)
 
 
-def make_stokes_run(p: StokesParams, nt_chunk: int):
+def _resolve_impl(impl):
+    from .common import resolve_pallas_impl
+
+    return resolve_pallas_impl(impl)
+
+
+def make_stokes_run(p: StokesParams, nt_chunk: int, impl: str | None = None):
+    impl = _resolve_impl(impl)
     return make_state_runner(
-        lambda s: stokes_step_local(s, p), (3,) * 8,
-        nt_chunk=nt_chunk, key=("stokes3d", p),
+        lambda s: stokes_step_local(s, p, impl), (3,) * 8,
+        nt_chunk=nt_chunk, key=("stokes3d", p, impl),
+        check_vma=False if impl.startswith("pallas") else None,
     )
 
 
-def run_stokes(state, p: StokesParams, nt: int, *, nt_chunk: int = 100):
+def run_stokes(state, p: StokesParams, nt: int, *, nt_chunk: int = 100,
+               impl: str | None = None):
     """Run ``nt`` PT iterations (one compiled program per chunk)."""
-    return run_chunked(lambda c: make_stokes_run(p, c), state, nt, nt_chunk)
+    impl = _resolve_impl(impl)
+    return run_chunked(lambda c: make_stokes_run(p, c, impl), state, nt,
+                       nt_chunk)
 
 
 _residual_cache: dict = {}

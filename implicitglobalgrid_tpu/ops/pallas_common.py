@@ -1,0 +1,106 @@
+"""Shared machinery of the fused multi-field Pallas passes
+(`pallas_wave.py`, `pallas_stokes.py`): recv-operand wiring, kernel-side
+recv unpacking, vma-aware output shapes, and the post-kernel delivery of a
+face-staggered field's extra x plane (the grid covers one plane fewer than
+the array)."""
+
+from __future__ import annotations
+
+__all__ = ["slab1", "take_recvs", "add_recv_operands", "out_shape_with_vma",
+           "vx_extra_plane_slabs", "AXIS_OF"]
+
+AXIS_OF = {"x": 0, "y": 1, "z": 2}
+
+
+def slab1(A, dim, start):
+    """Width-1 slice along ``dim``."""
+    from jax import lax
+
+    return lax.slice_in_dim(A, start, start + 1, axis=dim)
+
+
+def take_recvs(it, modes, field, kinds):
+    """Kernel-side: pull this field's recv refs off the operand iterator.
+
+    x recv blocks are (2, rows, cols) plane pairs — loaded whole; y/z recv
+    blocks are (1, ...) per-plane streams — the leading axis is dropped.
+    Non-participating kinds yield None (their operand was never passed)."""
+    got = {}
+    for k in kinds:
+        if not modes[field][AXIS_OF[k]]:
+            got[k] = None
+            continue
+        ref = next(it)
+        got[k] = ref[...] if k == "x" else ref[0]
+    return got
+
+
+def add_recv_operands(operands, in_specs, modes, recvs, field, kinds,
+                      shapes_specs):
+    """Host-side: append a field's participating recv slabs (concatenated
+    left+right) and their BlockSpecs, in the same order `take_recvs` reads
+    them."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    for k, (cat, blk, imap) in zip(kinds, shapes_specs):
+        if not modes[field][AXIS_OF[k]]:
+            continue
+        rl, rr = recvs[field][AXIS_OF[k]]
+        operands.append(jnp.concatenate([rl, rr], axis=cat))
+        in_specs.append(pl.BlockSpec(blk, imap))
+
+
+def out_shape_with_vma(a, operands):
+    """ShapeDtypeStruct for ``a`` carrying the joint mesh-axis variance of
+    every operand (shard_map's vma tracking), when the jax version has it."""
+    import jax
+
+    try:
+        vma = jax.typeof(a).vma
+        for op in operands:
+            vma = vma | jax.typeof(op).vma
+        return jax.ShapeDtypeStruct(a.shape, a.dtype, vma=vma)
+    except (AttributeError, TypeError):
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+
+def vx_extra_plane_slabs(Vx, Vxn, recvs_vx, modes_vx, nx):
+    """Final values of an x-staggered field's planes 0 and nx.
+
+    The fused kernels' grid has nx programs but the field has nx+1 planes:
+    plane nx is delivered (or kept raw) here, and plane 0 is rewritten with
+    its final value, via the in-place dim-0 halo write. The slab patching
+    preserves the z, x, y exchange order: the x recv slabs already carry z
+    corners (pipeline patching); the y recvs' corner rows go on top."""
+    from jax import lax
+
+    def lane_patch(plane, xpos):
+        if not modes_vx[2]:
+            return plane
+        zl, zr = recvs_vx[2]
+        zls = lax.slice_in_dim(zl, xpos, xpos + 1, axis=0)
+        zrs = lax.slice_in_dim(zr, xpos, xpos + 1, axis=0)
+        plane = lax.dynamic_update_slice_in_dim(plane, zls, 0, axis=2)
+        return lax.dynamic_update_slice_in_dim(
+            plane, zrs, plane.shape[2] - 1, axis=2)
+
+    def row_patch(plane, xpos):
+        if not modes_vx[1]:
+            return plane
+        yl, yr = recvs_vx[1]
+        yls = lax.slice_in_dim(yl, xpos, xpos + 1, axis=0)
+        yrs = lax.slice_in_dim(yr, xpos, xpos + 1, axis=0)
+        plane = lax.dynamic_update_slice_in_dim(plane, yls, 0, axis=1)
+        return lax.dynamic_update_slice_in_dim(
+            plane, yrs, plane.shape[1] - 1, axis=1)
+
+    if modes_vx[0]:
+        rl, rr = recvs_vx[0]         # z corners already patched in-pipeline
+        return row_patch(rl, 0), row_patch(rr, nx)
+    # no x exchange: plane nx keeps its raw values with the z then y recvs
+    # applied; plane 0 is already final in the kernel output.
+    planeN = row_patch(lane_patch(
+        lax.slice_in_dim(Vx, nx, nx + 1, axis=0), nx), nx)
+    plane0 = lax.slice_in_dim(Vxn, 0, 1, axis=0)
+    return plane0, planeN

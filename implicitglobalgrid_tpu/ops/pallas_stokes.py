@@ -1,0 +1,348 @@
+"""Fused Pallas pass for the pseudo-transient Stokes iteration — the kernel
+tier for BASELINE config 5 (`models/stokes.py`).
+
+One PT iteration reads the 8-field state and writes 7 arrays, with a
+4-field halo exchange at the end. The XLA formulation materializes the
+stress intermediates and pays ~2 extra passes for the exchange unpack; this
+module runs the WHOLE iteration — divergence, pressure, stresses, damped
+momentum, velocity updates, AND the (Vx, Vy, Vz, Pn) halo delivery — as one
+plane-pipelined Pallas pass (the Stokes analog of
+`pallas_wave.acoustic_step_exchange_pallas`).
+
+Soundness of fusing the exchange: every update reads only the PRE-step
+state (the sequential order is update-everything, then exchange), so the
+send slabs are computed from local thin windows. The slab computes reuse
+`models.stokes._stokes_terms` on MINI-STATES — all 8 fields sliced to a
+3-cell (cell-target) or 2-cell (face-target) window around the slab — whose
+central values are exactly the full-step values (the stencil radius fits
+the window; `_inner`'s trims align the mini interior with the target).
+Received slabs flow through the shared `exchange_recv_slabs` pipeline
+(ppermutes / local swaps / PROC_NULL masking / per-field corner patching),
+and are delivered in the kernel's output pass in the reference's z, x, y
+order. Vx's extra face plane (and dVx's, which is not exchanged) is
+written post-kernel like the acoustic kernel's.
+
+Requires the full-size face-aligned dV state of `init_stokes3d` and
+halowidth-1 grids; `stokes_exchange_modes` gates eligibility.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from .pallas_common import slab1 as _slab
+
+__all__ = ["stokes_exchange_modes", "stokes_step_exchange_pallas"]
+
+
+def stokes_exchange_modes(gg, shapes):
+    """Per-field participation modes for the fused PT iteration, or None.
+
+    ``shapes`` = the 8 state shapes (P, Vx, Vy, Vz, dVx, dVy, dVz, rhog).
+    Eligible when the shapes follow the model's staggering pattern (dV
+    mirroring V) and every halowidth is 1. Returns ``{"P": modes, "Vx":
+    ..., "Vy": ..., "Vz": ...}`` for the exchanged fields (all-False modes
+    mean a pure fused update with no deliveries)."""
+    from .halo import _dim_exchanges
+
+    sp, sx, sy, sz, sdx, sdy, sdz, srh = (
+        tuple(int(v) for v in s) for s in shapes)
+    if len(sp) != 3 or sp[0] < 3:
+        return None
+    if sp != tuple(int(n) for n in gg.nxyz) or srh != sp:
+        return None
+    nx, ny, nz = sp
+    if sx != (nx + 1, ny, nz) or sy != (nx, ny + 1, nz) \
+            or sz != (nx, ny, nz + 1):
+        return None
+    if (sdx, sdy, sdz) != (sx, sy, sz):
+        return None
+    if any(int(h) != 1 for h in gg.halowidths):
+        return None
+    hws = (1, 1, 1)
+    out = {}
+    for name, s in (("P", sp), ("Vx", sx), ("Vy", sy), ("Vz", sz)):
+        out[name] = tuple(_dim_exchanges(gg, s, hws, d) for d in range(3))
+    # all-False modes are still eligible: the kernel then fuses the whole
+    # PT iteration into one pass with no deliveries (single-chip
+    # non-periodic — the BASELINE bench configuration)
+    return out
+
+
+def _mini_state(state, dim, lo, hi):
+    """All 8 fields sliced to the cell-window ``[lo, hi)`` along ``dim``
+    (face-staggered fields get one extra layer)."""
+    from jax import lax
+
+    nc = state[0].shape[dim]
+    out = []
+    for a in state:
+        hi_a = hi + 1 if a.shape[dim] == nc + 1 else hi
+        out.append(lax.slice_in_dim(a, lo, hi_a, axis=dim))
+    return tuple(out)
+
+
+def _pn_get_slab(state, p):
+    """get_slab for Pn: the pressure update on a width-1 cell window (the
+    update is unmasked — every cell, incl. boundaries, gets it). Computed
+    directly (same div+update arithmetic as `_stokes_terms`) because the
+    1-cell window is too narrow for the stress terms' `_inner` trims."""
+    from ..models.stokes import _d
+
+    def get(dim, start, size):
+        assert size == 1
+        Pm, Vxm, Vym, Vzm = _mini_state(state, dim, start, start + 1)[:4]
+        divV = (_d(Vxm, 0) / p.dx + _d(Vym, 1) / p.dy + _d(Vzm, 2) / p.dz)
+        return Pm - p.dt_p * divV
+    return get
+
+
+def _v_get_slab(state, p, which):
+    """get_slab for velocity ``which`` (0=x,1=y,2=z): the full PT update on
+    a mini-state window; non-interior targets return raw slices (faces on
+    the global boundary are never updated)."""
+    from ..models.stokes import _stokes_terms
+
+    V = state[1 + which]
+
+    def get(dim, start, size):
+        assert size == 1
+        n = V.shape[dim]
+        if start < 1 or start > n - 2:
+            return _slab(V, dim, start)
+        stag = which == dim
+        lo, hi = (start - 1, start + 1) if stag else (start - 1, start + 2)
+        mini = _mini_state(state, dim, lo, hi)
+        terms = _stokes_terms(mini, p)
+        R = terms[2:][which]                  # (Rx, Ry, Rz)[which]
+        Vm = mini[1 + which]
+        dVm = mini[4 + which]
+        ix = (slice(1, -1),) * 3
+        dnew = p.damp * dVm[ix] + R
+        Vn = Vm.at[ix].add(p.dt_v * dnew)
+        return _slab(Vn, dim, start - lo)
+    return get
+
+
+def _stokes_kernel(*refs, nx, modes, mu, dt_v, dt_p, damp, dx, dy, dz):
+    """One x-plane of the fused PT iteration. Arithmetic mirrors
+    `models.stokes._stokes_terms` term-for-term (same accumulation order)
+    restricted to this plane; then the interior-masked dV/V updates and the
+    halo deliveries (z, x, y per field; Vx's x planes post-kernel)."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    from .pallas_wave import _deliver
+
+    it = iter(refs)
+    p_m, p_c = (next(it)[0] for _ in range(2))
+    vxm, vxc, vxp = (next(it)[0] for _ in range(3))
+    vym, vyc, vyp = (next(it)[0] for _ in range(3))
+    vzm, vzc, vzp = (next(it)[0] for _ in range(3))
+    dvxc = next(it)[0]
+    dvyc = next(it)[0]
+    dvzc = next(it)[0]
+    rhc = next(it)[0]
+
+    from .pallas_common import take_recvs
+
+    rP = take_recvs(it, modes, "P", ("x", "y", "z"))
+    rVx = take_recvs(it, modes, "Vx", ("y", "z"))
+    rVy = take_recvs(it, modes, "Vy", ("x", "y", "z"))
+    rVz = take_recvs(it, modes, "Vz", ("x", "y", "z"))
+    oP, oVx, oVy, oVz, odVx, odVy, odVz = refs[-7:]
+
+    i = pl.program_id(0)
+    ny, nz = p_c.shape
+
+    def d_y(a):
+        return a[1:, :] - a[:-1, :]
+
+    def d_z(a):
+        return a[:, 1:] - a[:, :-1]
+
+    # --- _stokes_terms restricted to cells i (c) and i-1 (m) --------------
+    divc = (vxp - vxc) / dx + d_y(vyc) / dy + d_z(vzc) / dz
+    divm = (vxc - vxm) / dx + d_y(vym) / dy + d_z(vzm) / dz
+    pnc = p_c - dt_p * divc
+    pnm = p_m - dt_p * divm
+    txxc = 2 * mu * ((vxp - vxc) / dx - divc / 3)
+    txxm = 2 * mu * ((vxc - vxm) / dx - divm / 3)
+    tyyc = 2 * mu * (d_y(vyc) / dy - divc / 3)
+    tzzc = 2 * mu * (d_z(vzc) / dz - divc / 3)
+    # edge stresses: _f at x-edge carried by face i, _fp by face i+1
+    txy_f = mu * (d_y(vxc) / dy + ((vyc - vym) / dx)[1:-1, :])
+    txy_fp = mu * (d_y(vxp) / dy + ((vyp - vyc) / dx)[1:-1, :])
+    txz_f = mu * (d_z(vxc) / dz + ((vzc - vzm) / dx)[:, 1:-1])
+    txz_fp = mu * (d_z(vxp) / dz + ((vzp - vzc) / dx)[:, 1:-1])
+    tyz_c = mu * (d_z(vyc)[1:-1, :] / dz + d_y(vzc)[:, 1:-1] / dy)
+
+    Rx = (((txxc - pnc) - (txxm - pnm))[1:-1, 1:-1] / dx
+          + d_y(txy_f)[:, 1:-1] / dy
+          + d_z(txz_f)[1:-1, :] / dz)                       # (ny-2, nz-2)
+    Ry = ((d_y(tyyc - pnc) / dy + (txy_fp - txy_f) / dx)[:, 1:-1]
+          + d_z(tyz_c) / dz)                                # (ny-1, nz-2)
+    rgf = 0.5 * (d_z(rhc) + 2 * rhc[:, :-1])                # (ny, nz-1)
+    Rz = ((d_z(tzzc - pnc) / dz + (txz_fp - txz_f) / dx)[1:-1, :]
+          + d_y(tyz_c) / dy
+          + rgf[1:-1, :])                                   # (ny-2, nz-1)
+
+    # --- interior-masked damped-momentum + velocity updates ---------------
+    row = lax.broadcasted_iota(jnp.int32, (ny, nz), 0)
+    col = lax.broadcasted_iota(jnp.int32, (ny, nz), 1)
+    rowy = lax.broadcasted_iota(jnp.int32, (ny + 1, nz), 0)
+    coly = lax.broadcasted_iota(jnp.int32, (ny + 1, nz), 1)
+    rowz = lax.broadcasted_iota(jnp.int32, (ny, nz + 1), 0)
+    colz = lax.broadcasted_iota(jnp.int32, (ny, nz + 1), 1)
+    face_ok = (i >= 1) & (i <= nx - 1)
+    cell_ok = (i >= 1) & (i <= nx - 2)
+
+    mx = face_ok & (row > 0) & (row < ny - 1) & (col > 0) & (col < nz - 1)
+    dnx = damp * dvxc + jnp.pad(Rx, ((1, 1), (1, 1)))
+    u_dvx = jnp.where(mx, dnx, dvxc)
+    u_vx = jnp.where(mx, vxc + dt_v * dnx, vxc)
+
+    my = cell_ok & (rowy > 0) & (rowy < ny) & (coly > 0) & (coly < nz - 1)
+    dny = damp * dvyc + jnp.pad(Ry, ((1, 1), (1, 1)))
+    u_dvy = jnp.where(my, dny, dvyc)
+    u_vy = jnp.where(my, vyc + dt_v * dny, vyc)
+
+    mz = cell_ok & (rowz > 0) & (rowz < ny - 1) & (colz > 0) & (colz < nz)
+    dnz = damp * dvzc + jnp.pad(Rz, ((1, 1), (1, 1)))
+    u_dvz = jnp.where(mz, dnz, dvzc)
+    u_vz = jnp.where(mz, vzc + dt_v * dnz, vzc)
+
+    # --- halo deliveries (z, x, y per field) ------------------------------
+    u_vx = _deliver(u_vx, i, nx, modes["Vx"], None, rVx["y"], rVx["z"],
+                    ny - 1, nz - 1)
+    u_vy = _deliver(u_vy, i, nx, modes["Vy"], rVy["x"], rVy["y"], rVy["z"],
+                    ny, nz - 1)
+    u_vz = _deliver(u_vz, i, nx, modes["Vz"], rVz["x"], rVz["y"], rVz["z"],
+                    ny - 1, nz)
+    pn = _deliver(pnc, i, nx, modes["P"], rP["x"], rP["y"], rP["z"],
+                  ny - 1, nz - 1)
+
+    oP[0] = pn
+    oVx[0] = u_vx
+    oVy[0] = u_vy
+    oVz[0] = u_vz
+    odVx[0] = u_dvx
+    odVy[0] = u_dvy
+    odVz[0] = u_dvz
+
+
+def stokes_step_exchange_pallas(state, gg, modes, p, *, interpret=False):
+    """One fused PT iteration (all updates + the 4-field halo exchange) for
+    arbitrary shardings. ``modes`` from `stokes_exchange_modes`."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    from .halo import exchange_recv_slabs
+
+    P, Vx, Vy, Vz, dVx, dVy, dVz, rhog = state
+    nx, ny, nz = P.shape
+    dtp = P.dtype.type
+    hws = (1, 1, 1)
+
+    recvs = {
+        "Vx": exchange_recv_slabs(gg, Vx.shape, hws, modes["Vx"],
+                                  _v_get_slab(state, p, 0)),
+        "Vy": exchange_recv_slabs(gg, Vy.shape, hws, modes["Vy"],
+                                  _v_get_slab(state, p, 1)),
+        "Vz": exchange_recv_slabs(gg, Vz.shape, hws, modes["Vz"],
+                                  _v_get_slab(state, p, 2)),
+        "P": exchange_recv_slabs(gg, P.shape, hws, modes["P"],
+                                 _pn_get_slab(state, p)),
+    }
+
+    def spec(shape, index_map):
+        return pl.BlockSpec(shape, index_map)
+
+    cP = (1, ny, nz)
+    cY = (1, ny + 1, nz)
+    cZ = (1, ny, nz + 1)
+    operands = [P, P, Vx, Vx, Vx, Vy, Vy, Vy, Vz, Vz, Vz,
+                dVx, dVy, dVz, rhog]
+    in_specs = [
+        spec(cP, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # P[i-1]
+        spec(cP, lambda i: (i, 0, 0)),                        # P[i]
+        spec(cP, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vx[i-1]
+        spec(cP, lambda i: (i, 0, 0)),                        # Vx[i]
+        spec(cP, lambda i: (i + 1, 0, 0)),                    # Vx[i+1]
+        spec(cY, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vy[i-1]
+        spec(cY, lambda i: (i, 0, 0)),                        # Vy[i]
+        spec(cY, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
+        spec(cZ, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vz[i-1]
+        spec(cZ, lambda i: (i, 0, 0)),                        # Vz[i]
+        spec(cZ, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
+        spec(cP, lambda i: (i, 0, 0)),                        # dVx[i]
+        spec(cY, lambda i: (i, 0, 0)),                        # dVy[i]
+        spec(cZ, lambda i: (i, 0, 0)),                        # dVz[i]
+        spec(cP, lambda i: (i, 0, 0)),                        # rhog[i]
+    ]
+
+    from .pallas_common import add_recv_operands, out_shape_with_vma
+
+    def add_recvs(field, kinds, shapes_specs):
+        add_recv_operands(operands, in_specs, modes, recvs, field, kinds,
+                          shapes_specs)
+
+    c0 = lambda i: (0, 0, 0)
+    ci = lambda i: (i, 0, 0)
+    add_recvs("P", ("x", "y", "z"), [
+        (0, (2, ny, nz), c0), (1, (1, 2, nz), ci), (2, (1, ny, 2), ci)])
+    add_recvs("Vx", ("y", "z"), [
+        (1, (1, 2, nz), ci), (2, (1, ny, 2), ci)])
+    add_recvs("Vy", ("x", "y", "z"), [
+        (0, (2, ny + 1, nz), c0), (1, (1, 2, nz), ci),
+        (2, (1, ny + 1, 2), ci)])
+    add_recvs("Vz", ("x", "y", "z"), [
+        (0, (2, ny, nz + 1), c0), (1, (1, 2, nz + 1), ci),
+        (2, (1, ny, 2), ci)])
+
+    def out_shape_of(a):
+        return out_shape_with_vma(a, operands)
+
+    kernel = partial(
+        _stokes_kernel, nx=nx,
+        modes={k: tuple(bool(b) for b in v) for k, v in modes.items()},
+        mu=dtp(p.mu), dt_v=dtp(p.dt_v), dt_p=dtp(p.dt_p), damp=dtp(p.damp),
+        dx=dtp(p.dx), dy=dtp(p.dy), dz=dtp(p.dz))
+
+    Pn, Vxn, Vyn, Vzn, dVxn, dVyn, dVzn = pl.pallas_call(
+        kernel,
+        grid=(nx,),
+        in_specs=in_specs,
+        out_specs=[
+            spec(cP, lambda i: (i, 0, 0)),
+            spec(cP, lambda i: (i, 0, 0)),
+            spec(cY, lambda i: (i, 0, 0)),
+            spec(cZ, lambda i: (i, 0, 0)),
+            spec(cP, lambda i: (i, 0, 0)),
+            spec(cY, lambda i: (i, 0, 0)),
+            spec(cZ, lambda i: (i, 0, 0)),
+        ],
+        out_shape=[out_shape_of(P), out_shape_of(Vx), out_shape_of(Vy),
+                   out_shape_of(Vz), out_shape_of(dVx), out_shape_of(dVy),
+                   out_shape_of(dVz)],
+        interpret=interpret,
+    )(*operands)
+
+    # Vx plane nx (the kernel grid covers planes 0..nx-1): delivered like
+    # the acoustic kernel's; dVx plane nx is never updated nor exchanged —
+    # rewritten with its raw values.
+    from .pallas_common import vx_extra_plane_slabs
+    from .pallas_halo import halo_write_inplace
+
+    plane0, planeN = vx_extra_plane_slabs(Vx, Vxn, recvs["Vx"],
+                                          modes["Vx"], nx)
+    Vxn = halo_write_inplace(Vxn, plane0, planeN, dim=0, hw=1,
+                             interpret=interpret)
+    dVxn = halo_write_inplace(
+        dVxn, lax.slice_in_dim(dVx, 0, 1, axis=0),
+        lax.slice_in_dim(dVx, nx, nx + 1, axis=0), dim=0, hw=1,
+        interpret=interpret)
+    return (Pn, Vxn, Vyn, Vzn, dVxn, dVyn, dVzn, rhog)

@@ -44,10 +44,10 @@ def wave_exchange_modes(gg, shapes):
     """Per-field participation modes for the fused acoustic step, or None.
 
     ``shapes`` = (P, Vx, Vy, Vz) local shapes. Eligible when the shapes
-    follow the model's staggering pattern (faces on +1 axes), every grid
-    halowidth is 1 (the delivery selects hardwire width-1 halos), and at
-    least one (field, dim) exchanges. Returns a dict
-    ``{"P": modes, "Vx": modes, ...}`` of 3-tuples."""
+    follow the model's staggering pattern (faces on +1 axes) and every
+    grid halowidth is 1 (the delivery selects hardwire width-1 halos).
+    Returns a dict ``{"P": modes, "Vx": modes, ...}`` of 3-tuples
+    (all-False modes mean a pure fused update with no deliveries)."""
     from .halo import _dim_exchanges
 
     sp, sx, sy, sz = (tuple(int(v) for v in s) for s in shapes)
@@ -65,8 +65,8 @@ def wave_exchange_modes(gg, shapes):
     out = {}
     for name, s in (("P", sp), ("Vx", sx), ("Vy", sy), ("Vz", sz)):
         out[name] = tuple(_dim_exchanges(gg, s, hws, d) for d in range(3))
-    if not any(any(m) for m in out.values()):
-        return None
+    # all-False modes are still eligible: the kernel then fuses both
+    # updates into one pass with no deliveries (single-chip non-periodic)
     return out
 
 
@@ -198,21 +198,12 @@ def _wave_kernel(*refs, nx, modes, cx, cy, cz, dtK, dx, dy, dz):
     vy_c = next(it)[0]
     vz_c = next(it)[0]
 
-    def take(field, kinds):
-        got = {}
-        for k in kinds:
-            if not modes[field][{"x": 0, "y": 1, "z": 2}[k]]:
-                got[k] = None
-                continue
-            ref = next(it)
-            # x recv blocks are (2, rows, cols) plane pairs — keep both
-            # planes; y/z recv blocks are (1, ...) streams — drop the axis.
-            got[k] = ref[...] if k == "x" else ref[0]
-        return got
-    rP = take("P", ("x", "y", "z"))
-    rVx = take("Vx", ("y", "z"))
-    rVy = take("Vy", ("x", "y", "z"))
-    rVz = take("Vz", ("x", "y", "z"))
+    from .pallas_common import take_recvs
+
+    rP = take_recvs(it, modes, "P", ("x", "y", "z"))
+    rVx = take_recvs(it, modes, "Vx", ("y", "z"))
+    rVy = take_recvs(it, modes, "Vy", ("x", "y", "z"))
+    rVz = take_recvs(it, modes, "Vz", ("x", "y", "z"))
     oP, oVx, oVy, oVz = refs[-4:]
 
     i = pl.program_id(0)
@@ -294,14 +285,11 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
         spec((1, ny, nz + 1), lambda i: (i, 0, 0)),
     ]
 
+    from .pallas_common import add_recv_operands, out_shape_with_vma
+
     def add_recvs(field, kinds, shapes_specs):
-        for k, (cat, blk, imap) in zip(kinds, shapes_specs):
-            d = {"x": 0, "y": 1, "z": 2}[k]
-            if not modes[field][d]:
-                continue
-            rl, rr = recvs[field][d]
-            operands.append(jnp.concatenate([rl, rr], axis=cat))
-            in_specs.append(spec(blk, imap))
+        add_recv_operands(operands, in_specs, modes, recvs, field, kinds,
+                          shapes_specs)
 
     c0 = lambda i: (0, 0, 0)
     ci = lambda i: (i, 0, 0)
@@ -317,13 +305,7 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
         (2, (1, ny, 2), ci)])
 
     def out_shape_of(a):
-        try:
-            vma = jax.typeof(a).vma
-            for op in operands:
-                vma = vma | jax.typeof(op).vma
-            return jax.ShapeDtypeStruct(a.shape, a.dtype, vma=vma)
-        except (AttributeError, TypeError):
-            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+        return out_shape_with_vma(a, operands)
 
     kernel = partial(
         _wave_kernel, nx=nx,
@@ -347,44 +329,12 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
 
     # The kernel wrote Vx planes 0..nx-1 of the (nx+1)-plane output; plane
     # nx is ALWAYS written here (it would otherwise be uninitialized), and
-    # plane 0 is rewritten with its final value. Slab-level patching keeps
-    # the z, x, y order: the x recv slabs already carry z corners (pipeline
-    # patching); the y recvs' corner rows go on top.
+    # plane 0 is rewritten with its final value (`vx_extra_plane_slabs`).
+    from .pallas_common import vx_extra_plane_slabs
     from .pallas_halo import halo_write_inplace
 
-    def lane_patch(plane, xpos):
-        """z recvs applied to a raw Vx plane sliced at ``xpos``."""
-        if not modes["Vx"][2]:
-            return plane
-        zl, zr = recvs["Vx"][2]
-        zls = lax.slice_in_dim(zl, xpos, xpos + 1, axis=0)
-        zrs = lax.slice_in_dim(zr, xpos, xpos + 1, axis=0)
-        plane = lax.dynamic_update_slice_in_dim(plane, zls, 0, axis=2)
-        return lax.dynamic_update_slice_in_dim(
-            plane, zrs, plane.shape[2] - 1, axis=2)
-
-    def row_patch(plane, xpos):
-        """y recvs applied to a Vx plane sliced at ``xpos``."""
-        if not modes["Vx"][1]:
-            return plane
-        yl, yr = recvs["Vx"][1]
-        yls = lax.slice_in_dim(yl, xpos, xpos + 1, axis=0)
-        yrs = lax.slice_in_dim(yr, xpos, xpos + 1, axis=0)
-        plane = lax.dynamic_update_slice_in_dim(plane, yls, 0, axis=1)
-        return lax.dynamic_update_slice_in_dim(
-            plane, yrs, plane.shape[1] - 1, axis=1)
-
-    if modes["Vx"][0]:
-        rl, rr = recvs["Vx"][0]      # z corners already patched in-pipeline
-        plane0 = row_patch(rl, 0)
-        planeN = row_patch(rr, nx)
-    else:
-        # no x exchange: plane nx keeps its raw boundary values, with the
-        # z then y recvs applied; plane 0 is already final in the kernel
-        # output (delivered there).
-        planeN = row_patch(lane_patch(
-            lax.slice_in_dim(Vx, nx, nx + 1, axis=0), nx), nx)
-        plane0 = lax.slice_in_dim(Vxn, 0, 1, axis=0)
+    plane0, planeN = vx_extra_plane_slabs(Vx, Vxn, recvs["Vx"],
+                                          modes["Vx"], nx)
     Vxn = halo_write_inplace(Vxn, plane0, planeN, dim=0, hw=1,
                              interpret=interpret)
     return (Pn, Vxn, Vyn, Vzn)

@@ -113,12 +113,43 @@ def test_acoustic_pallas_fused_matches_xla(dims, periods, label):
         tuple(int(s) // int(gg.dims[d]) for d, s in enumerate(a.shape))
         for a in state)
     modes = wave_exchange_modes(gg, shapes)
+    assert modes is not None, label
     if periods == (0, 0, 0) and dims == (1, 1, 1):
-        assert modes is None, label  # nothing exchanges -> XLA fallthrough
-    else:
-        assert modes is not None, label
+        # nothing exchanges: all-False modes -> pure fused update
+        assert not any(any(m) for m in modes.values()), label
     a = run_acoustic(state, p, 6, nt_chunk=3, impl="xla")
     b = run_acoustic(state, p, 6, nt_chunk=3, impl="pallas_interpret")
     for fa, fb, name in zip(a, b, ("P", "Vx", "Vy", "Vz")):
         ga, gb = np.asarray(igg.gather(fa)), np.asarray(igg.gather(fb))
         assert np.allclose(ga, gb, rtol=1e-5, atol=1e-5), (label, name)
+
+
+@pytest.mark.parametrize("dims,periods,label", [
+    ((1, 1, 1), (1, 1, 1), "all self-neighbor"),
+    ((2, 2, 2), (0, 0, 0), "all multi-shard PROC_NULL edges"),
+    ((2, 2, 2), (1, 1, 1), "all multi-shard periodic"),
+    ((1, 2, 4), (1, 0, 1), "self x + PROC_NULL y + 4-shard z"),
+])
+def test_stokes_pallas_fused_matches_xla(dims, periods, label):
+    """The fused Stokes Pallas pass (all PT updates + 4-field exchange in
+    ONE kernel, `ops/pallas_stokes.py`) must reproduce the XLA step +
+    sequential exchanges over a multi-iteration run."""
+    from implicitglobalgrid_tpu.ops.pallas_stokes import stokes_exchange_modes
+
+    igg.init_global_grid(8, 8, 16, dimx=dims[0], dimy=dims[1], dimz=dims[2],
+                         periodx=periods[0], periody=periods[1],
+                         periodz=periods[2], quiet=True)
+    gg = igg.global_grid()
+    state, p = init_stokes3d(dtype=np.float32)
+    shapes = tuple(
+        tuple(int(s) // int(gg.dims[d]) for d, s in enumerate(a.shape))
+        for a in state)
+    assert stokes_exchange_modes(gg, shapes) is not None, label
+    a = run_stokes(state, p, 4, nt_chunk=2, impl="xla")
+    b = run_stokes(state, p, 4, nt_chunk=2, impl="pallas_interpret")
+    names = ("P", "Vx", "Vy", "Vz", "dVx", "dVy", "dVz", "rhog")
+    for fa, fb, name in zip(a, b, names):
+        ga, gb = np.asarray(igg.gather(fa)), np.asarray(igg.gather(fb))
+        scale = max(1e-30, np.abs(ga).max())
+        assert np.allclose(ga, gb, rtol=1e-4, atol=1e-5 * scale), (
+            label, name, np.abs(ga - gb).max())
