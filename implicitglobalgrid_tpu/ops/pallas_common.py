@@ -7,7 +7,7 @@ the array)."""
 from __future__ import annotations
 
 __all__ = ["slab1", "take_recvs", "add_recv_operands", "out_shape_with_vma",
-           "vx_extra_plane_slabs", "AXIS_OF"]
+           "vx_extra_plane_slabs", "deliver_recvs", "AXIS_OF"]
 
 AXIS_OF = {"x": 0, "y": 1, "z": 2}
 
@@ -104,3 +104,25 @@ def vx_extra_plane_slabs(Vx, Vxn, recvs_vx, modes_vx, nx):
         lax.slice_in_dim(Vx, nx, nx + 1, axis=0), nx), nx)
     plane0 = lax.slice_in_dim(Vxn, 0, 1, axis=0)
     return plane0, planeN
+
+
+def deliver_recvs(u, i, nx_planes, modes, rx, ry, rz, row_hi, col_hi):
+    """Apply a field's received halo slabs to its computed plane ``u``, in
+    the reference order z, x, y. ``rx`` is None for fields whose x planes
+    are written post-kernel (Vx). ``row_hi``/``col_hi`` are the last
+    row/lane indices of the plane (staggered extents differ)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rows, cols = u.shape
+    row = lax.broadcasted_iota(jnp.int32, (rows, cols), 0)
+    col = lax.broadcasted_iota(jnp.int32, (rows, cols), 1)
+    if modes[2]:
+        u = jnp.where(col == 0, rz[:, 0:1], u)
+        u = jnp.where(col == col_hi, rz[:, 1:2], u)
+    if modes[0] and rx is not None:
+        u = jnp.where(i == 0, rx[0], jnp.where(i == nx_planes - 1, rx[1], u))
+    if modes[1]:
+        u = jnp.where(row == 0, ry[0:1, :], u)
+        u = jnp.where(row == row_hi, ry[1:2, :], u)
+    return u

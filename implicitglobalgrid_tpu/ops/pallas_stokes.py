@@ -133,7 +133,7 @@ def _stokes_kernel(*refs, nx, modes, mu, dt_v, dt_p, damp, dx, dy, dz):
     from jax import lax
     from jax.experimental import pallas as pl
 
-    from .pallas_wave import _deliver
+    from .pallas_common import deliver_recvs as _deliver
 
     it = iter(refs)
     p_m, p_c = (next(it)[0] for _ in range(2))

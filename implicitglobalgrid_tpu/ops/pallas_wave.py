@@ -37,6 +37,9 @@ from __future__ import annotations
 
 from functools import partial
 
+from .pallas_common import deliver_recvs as _deliver
+from .pallas_common import slab1 as _slab
+
 __all__ = ["wave_exchange_modes", "acoustic_step_exchange_pallas"]
 
 
@@ -100,12 +103,6 @@ def _upd_v_inplane(V, P, axis, c):
     return V + c * jnp.pad(d, pads)
 
 
-def _slab(A, dim, start):
-    from jax import lax
-
-    return lax.slice_in_dim(A, start, start + 1, axis=dim)
-
-
 def _make_v_get_slab(V, P, axis, c):
     """get_slab for a velocity field staggered along ``axis``: returns the
     POST-update values of the width-1 slab at ``start`` along ``dim``."""
@@ -161,28 +158,6 @@ def _make_p_get_slab(P, Vx, Vy, Vz, cx, cy, cz, dtK, dx, dy, dz):
         return Ps - dtK * (div_term(vxn, 0, dx) + divs
                            + div_term(von, oa, od))
     return get
-
-
-def _deliver(u, i, nx_planes, modes, rx, ry, rz, row_hi, col_hi):
-    """Apply a field's received halo slabs to its computed plane ``u``, in
-    the reference order z, x, y. ``rx`` is None for fields whose x planes
-    are written post-kernel (Vx). ``row_hi``/``col_hi`` are the last
-    row/lane indices of the plane (staggered extents differ)."""
-    import jax.numpy as jnp
-    from jax import lax
-
-    rows, cols = u.shape
-    row = lax.broadcasted_iota(jnp.int32, (rows, cols), 0)
-    col = lax.broadcasted_iota(jnp.int32, (rows, cols), 1)
-    if modes[2]:
-        u = jnp.where(col == 0, rz[:, 0:1], u)
-        u = jnp.where(col == col_hi, rz[:, 1:2], u)
-    if modes[0] and rx is not None:
-        u = jnp.where(i == 0, rx[0], jnp.where(i == nx_planes - 1, rx[1], u))
-    if modes[1]:
-        u = jnp.where(row == 0, ry[0:1, :], u)
-        u = jnp.where(row == row_hi, ry[1:2, :], u)
-    return u
 
 
 def _wave_kernel(*refs, nx, modes, cx, cy, cz, dtK, dx, dy, dz):

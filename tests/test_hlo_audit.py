@@ -187,6 +187,37 @@ def test_fused_step_2d_permutes():
     _assert_slab_sized_permutes(hlo, (16, 16))
 
 
+def test_fused_acoustic_permutes():
+    """Fused acoustic pass on a 2x2x2 periodic mesh: 4 fields x 3 axes x 2
+    directions = 24 slab-sized permutes, nothing else."""
+    from implicitglobalgrid_tpu.models import init_acoustic3d, make_acoustic_run
+
+    igg.init_global_grid(8, 8, 16, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    state, p = init_acoustic3d(dtype=np.float32)
+    fn = make_acoustic_run(p, 1, impl="pallas_interpret")
+    hlo = fn.lower(*state).compile().as_text()
+    assert _count_collective_permutes(hlo) == 24
+    assert "all-reduce" not in hlo and "all-gather" not in hlo
+    _assert_slab_sized_permutes(hlo, (8, 8, 16))
+
+
+def test_fused_stokes_permutes():
+    """Fused Stokes pass on a 2x2x2 periodic mesh: the 4 EXCHANGED fields
+    (Pn, Vx, Vy, Vz) x 3 axes x 2 directions = 24 slab-sized permutes —
+    the dV fields must not add wire traffic."""
+    from implicitglobalgrid_tpu.models import init_stokes3d, make_stokes_run
+
+    igg.init_global_grid(8, 8, 16, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    state, p = init_stokes3d(dtype=np.float32)
+    fn = make_stokes_run(p, 1, impl="pallas_interpret")
+    hlo = fn.lower(*state).compile().as_text()
+    assert _count_collective_permutes(hlo) == 24
+    assert "all-reduce" not in hlo and "all-gather" not in hlo
+    _assert_slab_sized_permutes(hlo, (8, 8, 16))
+
+
 def test_permute_count_with_halowidth_2():
     """halowidth>1 exchanges still cost one pair per axis (slab width is
     static, not a per-row loop)."""
