@@ -139,7 +139,7 @@ def diffusion3d_step_halo_pallas(T, Cp, *, lam, dt, dx, dy, dz, fuse,
     nx, ny, nz = T.shape
     plane = (1, ny, nz)
     fuse_x = bool(fuse[0])
-    dtp = T.dtype.type
+    dtp = _const_dtype(T.dtype)
     kernel = partial(
         _plane_halo_kernel,
         lam=dtp(lam), dt=dtp(dt), dx=dtp(dx), dy=dtp(dy), dz=dtp(dz),
@@ -328,7 +328,7 @@ def diffusion3d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
 
     nx, ny, nz = T.shape
     plane = (1, ny, nz)
-    dtp = T.dtype.type
+    dtp = _const_dtype(T.dtype)
     consts = dict(lam=dtp(lam), dt=dtp(dt), dx=dtp(dx), dy=dtp(dy), dz=dtp(dz))
 
     recvs = exchange_recv_slabs(
@@ -390,22 +390,46 @@ _MP_VMEM_BUDGET = 13 * 1024 * 1024  # leave headroom under the ~16 MB VMEM
 _MP_TEMP_PLANES = 6  # slack for Mosaic stencil temporaries (qy/qz/acc/masks)
 
 
+def _compute_itemsize(dtype) -> int:
+    """Bytes per element of the stencil's COMPUTE dtype: bf16 states are
+    computed in f32 (`_stencil_plane`), so their temporaries cost 4 B."""
+    return max(int(dtype.itemsize), 4) if dtype.itemsize < 4 \
+        else int(dtype.itemsize)
+
+
+def _const_dtype(dtype):
+    """Scalar constructor for the kernel constants: f32 for bf16 states
+    (quantizing dx/dt to bf16 would put ~0.4% systematic error into every
+    flux term that the f32 compute path is meant to avoid), the state's own
+    dtype otherwise."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if dtype == jnp.bfloat16:
+        return np.float32
+    return dtype.type
+
+
 def mp_planes(T):
     """Plane count P for the multi-plane kernel, or None if unsupported.
 
     Picks the largest candidate P that divides the plane axis with >= 2
     programs and whose VMEM working set fits: double-buffered (P+2)-plane T
     windows (2*(P+2)) plus double-buffered Cp in and out blocks (2*P each)
-    plus temporaries slack. Larger P amortizes the 2-plane window overlap
+    in STORAGE dtype, plus per-plane temporaries slack in COMPUTE dtype
+    (bf16 computes in f32). Larger P amortizes the 2-plane window overlap
     (T read amplification 1+2/P); the plane-per-program kernel is the
     fallback for everything else."""
     if T.ndim != 3:
         return None
-    plane_bytes = int(T.shape[1]) * int(T.shape[2]) * T.dtype.itemsize
+    cells = int(T.shape[1]) * int(T.shape[2])
+    plane_store = cells * T.dtype.itemsize
+    plane_compute = cells * _compute_itemsize(T.dtype)
     for P in _MP_CANDIDATES:
         if T.shape[0] % P or T.shape[0] < 2 * P:
             continue
-        working_set = (6 * P + 4 + _MP_TEMP_PLANES) * plane_bytes
+        working_set = (6 * P + 4) * plane_store \
+            + _MP_TEMP_PLANES * plane_compute
         if working_set <= _MP_VMEM_BUDGET:
             return P
     return None
@@ -420,9 +444,14 @@ def _stencil_plane(tm, tc, tp, cp, *, lam, dt, dx, dy, dz):
     """The flux-form update of one plane (or a 3-D slab — y/z derivatives
     run over the LAST two axes) — the single shared arithmetic (same
     accumulation order as the reference example and the plane-per-program
-    kernel)."""
+    kernel). bfloat16 inputs are computed in f32 and cast back (bf16
+    storage, f32 arithmetic — the TPU-native mixed-precision recipe; the
+    flux differences would otherwise lose most of their bits)."""
     import jax.numpy as jnp
 
+    out_dt = tc.dtype
+    if out_dt == jnp.bfloat16:
+        tm, tc, tp, cp = (a.astype(jnp.float32) for a in (tm, tc, tp, cp))
     zeros = [(0, 0)] * (tc.ndim - 2)
     qxr = -lam * (tp - tc) / dx
     qxl = -lam * (tc - tm) / dx
@@ -433,23 +462,27 @@ def _stencil_plane(tm, tc, tp, cp, *, lam, dt, dx, dy, dz):
     qz = -lam * (tc[..., :, 1:] - tc[..., :, :-1]) / dz
     acc = acc - jnp.pad((qz[..., :, 1:] - qz[..., :, :-1]) / dz,
                         zeros + [(0, 0), (1, 1)])
-    return tc + dt * (acc / cp)
+    return (tc + dt * (acc / cp)).astype(out_dt)
 
 
 def _stencil_row(tm, tc, tp, cp, *, lam, dt, dx, dy):
     """2-D flux-form update of a row strip: the x-derivative comes from the
     ``tm``/``tc``/``tp`` row triple, the y-derivative runs over the LAST
     axis — same accumulation order as the XLA 2-D step
-    (`models/diffusion.upd2`, mirroring the reference example's sequence)."""
+    (`models/diffusion.upd2`, mirroring the reference example's sequence).
+    bfloat16 inputs compute in f32 like `_stencil_plane`."""
     import jax.numpy as jnp
 
+    out_dt = tc.dtype
+    if out_dt == jnp.bfloat16:
+        tm, tc, tp, cp = (a.astype(jnp.float32) for a in (tm, tc, tp, cp))
     zeros = [(0, 0)] * (tc.ndim - 1)
     qxr = -lam * (tp - tc) / dx
     qxl = -lam * (tc - tm) / dx
     acc = -((qxr - qxl) / dx)
     qy = -lam * (tc[..., 1:] - tc[..., :-1]) / dy
     acc = acc - jnp.pad((qy[..., 1:] - qy[..., :-1]) / dy, zeros + [(1, 1)])
-    return tc + dt * (acc / cp)
+    return (tc + dt * (acc / cp)).astype(out_dt)
 
 
 def _window_pipeline(T_hbm, scratch, sems, *, nx, B):
@@ -564,7 +597,7 @@ def diffusion3d_step_halo_pallas_mp(T, Cp, *, lam, dt, dx, dy, dz, fuse,
     nx, ny, nz = T.shape
     P = mp_planes(T)
     blk = (P, ny, nz)
-    dtp = T.dtype.type
+    dtp = _const_dtype(T.dtype)
     consts = dict(lam=dtp(lam), dt=dtp(dt), dx=dtp(dx), dy=dtp(dy), dz=dtp(dz))
     kernel = partial(_mp_kernel, nx=nx, P=P,
                      fuse=tuple(bool(f) for f in fuse), **consts)
@@ -631,17 +664,18 @@ _STRIP2D_CANDIDATES = (256, 128, 64, 32, 16, 8)
 def strip_rows_2d(T):
     """Rows per program R for the 2-D strip kernel, or None if unsupported.
 
-    Working set: double-buffered (R+2)-row T windows, double-buffered Cp in
-    and out blocks (2R rows each), plus the shifted-window temporaries of the
-    vectorized strip compute (~2(R+2)) and stencil temporaries — budgeted at
-    ~12R+8 rows."""
+    Working set: double-buffered (R+2)-row T windows plus double-buffered
+    Cp in and out blocks (2R rows each) in STORAGE dtype, plus the
+    shifted-window temporaries of the vectorized strip compute (~6R rows)
+    in COMPUTE dtype (bf16 computes in f32)."""
     if T.ndim != 2:
         return None
-    row_bytes = int(T.shape[1]) * T.dtype.itemsize
+    row_store = int(T.shape[1]) * T.dtype.itemsize
+    row_compute = int(T.shape[1]) * _compute_itemsize(T.dtype)
     for R in _STRIP2D_CANDIDATES:
         if T.shape[0] % R or T.shape[0] < 2 * R:
             continue
-        if (12 * R + 8) * row_bytes <= _MP_VMEM_BUDGET:
+        if (6 * R + 8) * row_store + 6 * R * row_compute <= _MP_VMEM_BUDGET:
             return R
     return None
 
@@ -709,7 +743,7 @@ def diffusion2d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
 
     nx, ny = T.shape
     R = strip_rows_2d(T)
-    dtp = T.dtype.type
+    dtp = _const_dtype(T.dtype)
     consts = dict(lam=dtp(lam), dt=dtp(dt), dx=dtp(dx), dy=dtp(dy))
 
     recvs = exchange_recv_slabs(

@@ -153,8 +153,19 @@ def dims_create(nprocs: int, dims) -> np.ndarray:
             )
         return dims
     # Balanced split of `rem` into len(free) factors, non-increasing.
+    # The search branches only on DIVISORS (computed once in O(sqrt rem)),
+    # so even nprocs ~ 2^20 costs microseconds, not a dense integer sweep.
     best = None
     k = len(free)
+    divs = []
+    f = 1
+    while f * f <= rem:
+        if rem % f == 0:
+            divs.append(f)
+            if f != rem // f:
+                divs.append(rem // f)
+        f += 1
+    divs.sort(reverse=True)
 
     def search(remaining, max_factor, acc):
         nonlocal best
@@ -165,11 +176,9 @@ def dims_create(nprocs: int, dims) -> np.ndarray:
                 if best is None or score < best[0]:
                     best = (score, cand)
             return
-        f = max_factor
-        while f >= 1:
-            if remaining % f == 0:
+        for f in divs:
+            if f <= max_factor and remaining % f == 0:
                 search(remaining // f, f, acc + [f])
-            f -= 1
 
     search(rem, rem, [])
     if best is None:  # pragma: no cover - rem>=1 always factorizable

@@ -206,3 +206,54 @@ def test_step_exchange_modes_gates():
     # unstaggered, only x multi-shard (y/z single-shard non-periodic)
     assert step_exchange_modes(
         gg, jax.ShapeDtypeStruct((8, 8, 8), np.float32)) == (True, False, False)
+
+
+def test_mp_planes_vmem_selection():
+    """Plane-count selection respects the VMEM budget: f32 256-cube picks a
+    smaller P than bf16 (half the plane bytes), tiny blocks fall back."""
+    import jax
+
+    from implicitglobalgrid_tpu.ops.pallas_stencil import (
+        _MP_VMEM_BUDGET, _MP_TEMP_PLANES, mp_planes, strip_rows_2d,
+    )
+
+    import jax.numpy as jnp
+
+    P32 = mp_planes(jax.ShapeDtypeStruct((256, 256, 256), np.float32))
+    P16 = mp_planes(jax.ShapeDtypeStruct((256, 256, 256), jnp.bfloat16))
+    assert P32 is not None and P16 is not None and P16 >= P32
+    ws = (6 * P32 + 4 + _MP_TEMP_PLANES) * 256 * 256 * 4
+    assert ws <= _MP_VMEM_BUDGET  # the chosen P actually fits the budget
+    # bf16 temporaries cost f32 (compute dtype): the model accounts for it
+    from implicitglobalgrid_tpu.ops.pallas_stencil import _compute_itemsize
+    assert _compute_itemsize(np.dtype(jnp.bfloat16)) == 4
+    # indivisible plane axis -> None
+    assert mp_planes(jax.ShapeDtypeStruct((7, 256, 256), np.float32)) is None
+    # 2-D strip selection fits the budget too
+    R = strip_rows_2d(jax.ShapeDtypeStruct((4096, 4096), np.float32))
+    assert R is not None and (12 * R + 8) * 4096 * 4 <= _MP_VMEM_BUDGET
+    # bf16 strips: f32 temporaries halve R vs the naive bf16-only estimate
+    Rb = strip_rows_2d(jax.ShapeDtypeStruct((8192, 8192), jnp.bfloat16))
+    assert Rb is not None
+    assert (6 * Rb + 8) * 8192 * 2 + 6 * Rb * 8192 * 4 <= _MP_VMEM_BUDGET
+
+
+def test_pallas_bf16_f32_accumulation_beats_plain_bf16():
+    """The kernels compute bf16 states in f32 (storage stays bf16): over a
+    multi-step run they must track the f32 solution at least as well as
+    the plain bf16 XLA arithmetic."""
+    import jax.numpy as jnp
+
+    igg.init_global_grid(16, 16, 16, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    T32, Cp32, p = init_diffusion3d(dtype=np.float32)
+    ref = np.asarray(run_diffusion(T32, Cp32, p, 20, nt_chunk=10,
+                                   impl="xla")).astype(np.float64)
+    T16, Cp16, p16 = init_diffusion3d(dtype=jnp.bfloat16)
+    a = np.asarray(run_diffusion(T16, Cp16, p16, 20, nt_chunk=10,
+                                 impl="xla")).astype(np.float64)
+    b = np.asarray(run_diffusion(T16, Cp16, p16, 20, nt_chunk=10,
+                                 impl="pallas_interpret")).astype(np.float64)
+    err_xla = np.abs(a - ref).max()
+    err_pal = np.abs(b - ref).max()
+    assert err_pal <= err_xla * 1.05, (err_pal, err_xla)
