@@ -14,6 +14,7 @@ pod, point it at the real devices (no --cpu) and the number is the real one.
 
 Usage: python bench_weak.py --cpu [--devices N]   (virtual mesh harness)
        python bench_weak.py                       (real devices, needs >1 chip)
+       add --strong for STRONG scaling (fixed global size, shrinking blocks)
 """
 
 from __future__ import annotations
@@ -58,9 +59,11 @@ def main() -> None:
     local_n, nt = (48, 60) if cpu else (256, 600)
     chunk = max(1, nt // 6)
 
-    def measure(nd):
+    strong = "--strong" in sys.argv
+
+    def measure(nd, block):
         dims = tuple(int(d) for d in igg.dims_create(nd, (0, 0, 0)))
-        igg.init_global_grid(local_n, local_n, local_n,
+        igg.init_global_grid(block[0], block[1], block[2],
                              dimx=dims[0], dimy=dims[1], dimz=dims[2],
                              periodx=1, periody=1, periodz=1,
                              devices=devices[:nd], quiet=True)
@@ -72,8 +75,30 @@ def main() -> None:
         igg.finalize_global_grid()
         return t
 
-    t1 = measure(1)
-    tn = measure(n)
+    if strong:
+        # STRONG scaling: fixed global work, local blocks shrink PER AXIS
+        # by that axis' device count (the global grid stays ~fixed up to
+        # the implicit-size overlap terms); efficiency on per-cell rates:
+        # eff = rate_N_total / (N * rate_1).
+        nd_dims = tuple(int(d) for d in igg.dims_create(n, (0, 0, 0)))
+        block_n = tuple(max(8, local_n // d) for d in nd_dims)
+        t1 = measure(1, (local_n,) * 3)
+        tn = measure(n, block_n)
+        r1 = local_n ** 3 * nt / t1
+        rn = int(np.prod(block_n)) * n * nt / tn
+        eff = rn / (r1 * n)
+        bench_util.emit({
+            "metric": "strong_scaling_efficiency",
+            "value": eff,
+            "unit": f"rateN/(N*rate1), N={n}",
+            "local_block": list(block_n),
+            "note": ("virtual CPU mesh (devices share host cores; "
+                     "understates real hardware)" if cpu else "real devices"),
+        })
+        return
+
+    t1 = measure(1, (local_n,) * 3)
+    tn = measure(n, (local_n,) * 3)
     eff = t1 / tn
     bench_util.emit({
         "metric": "weak_scaling_efficiency",
@@ -88,5 +113,8 @@ def main() -> None:
 if __name__ == "__main__":
     if bench_util.is_child():
         main()
+    elif "--strong" in sys.argv:
+        bench_util.run_with_retries("strong_scaling_efficiency",
+                                    "rateN/(N*rate1)")
     else:
         bench_util.run_with_retries("weak_scaling_efficiency", "t1/tN")
