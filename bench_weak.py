@@ -49,9 +49,12 @@ def main() -> None:
     devices = jax.devices()
     n = n_req or len(devices)
     if n < 2:
+        strong_early = "--strong" in sys.argv
         print(json.dumps({
-            "metric": "weak_scaling_efficiency", "value": None,
-            "unit": "t1/tN",
+            "metric": ("strong" if strong_early else "weak")
+                      + "_scaling_efficiency",
+            "value": None,
+            "unit": "rateN/(N*rate1)" if strong_early else "t1/tN",
             "note": "needs >1 device; run with --cpu for the virtual-mesh harness",
         }))
         return

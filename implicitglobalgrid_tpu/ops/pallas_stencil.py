@@ -311,18 +311,69 @@ def _plane_step_recv_kernel(*refs, nx, modes, lam, dt, dx, dy, dz):
     o_ref[0] = u
 
 
+def _mp_step_recv_kernel(*refs, nx, P, modes, lam, dt, dx, dy, dz):
+    """Multi-plane form of `_plane_step_recv_kernel`: P output planes per
+    program from a double-buffered (P+2)-plane T window (`_window_pipeline`
+    — the same HBM-traffic win as `_mp_kernel`), each delivered its
+    received slabs in the z, x, y order."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    it = iter(refs)
+    T_hbm = next(it)
+    cp_ref = next(it)                              # (P, ny, nz)
+    rx_ref = next(it) if modes[0] else None        # (2, ny, nz) const
+    ry_ref = next(it) if modes[1] else None        # (P, 2, nz)
+    rz_ref = next(it) if modes[2] else None        # (P, ny, 2)
+    out_ref = refs[-3]
+    scratch = refs[-2]
+    sems = refs[-1]
+
+    win, l0 = _window_pipeline(T_hbm, scratch, sems, nx=nx, B=P)
+    g0 = pl.program_id(0) * P
+
+    ny, nz = out_ref.shape[1:]
+    row = lax.broadcasted_iota(jnp.int32, (ny, nz), 0)
+    col = lax.broadcasted_iota(jnp.int32, (ny, nz), 1)
+    interior_yz = (row > 0) & (row < ny - 1) & (col > 0) & (col < nz - 1)
+
+    for j in range(P):
+        g = g0 + j
+        l = l0 + j
+        tc = win[pl.ds(l, 1)][0]
+        tm = win[pl.ds(jnp.maximum(l - 1, 0), 1)][0]
+        tp = win[pl.ds(jnp.minimum(l + 1, P + 1), 1)][0]
+        upd = _stencil_plane(tm, tc, tp, cp_ref[j],
+                             lam=lam, dt=dt, dx=dx, dy=dy, dz=dz)
+        u = jnp.where(interior_yz & (g > 0) & (g < nx - 1), upd, tc)
+        if modes[2]:  # halowidth 1 throughout (step_exchange_modes)
+            u = jnp.where(col == 0, rz_ref[j, :, 0:1], u)
+            u = jnp.where(col == nz - 1, rz_ref[j, :, 1:2], u)
+        if modes[0]:
+            u = jnp.where(g == 0, rx_ref[0],
+                          jnp.where(g == nx - 1, rx_ref[1], u))
+        if modes[1]:
+            u = jnp.where(row == 0, ry_ref[j, 0:1, :], u)
+            u = jnp.where(row == ny - 1, ry_ref[j, 1:2, :], u)
+        out_ref[j] = u
+
+
 def diffusion3d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
                                      dz, interpret=False):
     """Fused diffusion step + full halo exchange for arbitrary shardings
     (see module comment above): thin-slab send computation -> the shared
     `exchange_recv_slabs` pipeline -> one Pallas pass for update + delivery.
-    Matches `diffusion3d_step_pallas` followed by the exchange to ulp level:
+    Uses the multi-plane window kernel where the shape gate passes
+    ((1+2/P)x T reads), else the plane-per-program form (3x). Matches
+    `diffusion3d_step_pallas` followed by the exchange to ulp level:
     the slab computes share `_stencil_plane`'s accumulation order, but they
     run through XLA while the block runs through Mosaic, and fma contraction
     can differ in the last ulp between the compilers (module docstring)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     from .halo import exchange_recv_slabs
 
@@ -336,13 +387,26 @@ def diffusion3d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
         lambda dim, start, size: _xla_update_slab(T, Cp, dim, start, size,
                                                   consts))
 
-    operands = [T, T, T, Cp]
-    in_specs = [
-        pl.BlockSpec(plane, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),
-        pl.BlockSpec(plane, lambda i: (i, 0, 0)),
-        pl.BlockSpec(plane, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
-        pl.BlockSpec(plane, lambda i: (i, 0, 0)),
-    ]
+    P = mp_planes(T)
+    mp = P is not None
+    blk = (P, ny, nz) if mp else plane
+
+    operands = []
+    in_specs = []
+    if mp:
+        operands += [T, Cp]
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),      # T: manual DMA window
+            pl.BlockSpec(blk, lambda i: (i, 0, 0)),
+        ]
+    else:
+        operands += [T, T, T, Cp]
+        in_specs += [
+            pl.BlockSpec(plane, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),
+            pl.BlockSpec(plane, lambda i: (i, 0, 0)),
+            pl.BlockSpec(plane, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
+            pl.BlockSpec(plane, lambda i: (i, 0, 0)),
+        ]
     if modes[0]:
         rx = jnp.concatenate(recvs[0], axis=0)          # (2, ny, nz)
         operands.append(rx)
@@ -350,11 +414,11 @@ def diffusion3d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
     if modes[1]:
         ry = jnp.concatenate(recvs[1], axis=1)          # (nx, 2, nz)
         operands.append(ry)
-        in_specs.append(pl.BlockSpec((1, 2, nz), lambda i: (i, 0, 0)))
+        in_specs.append(pl.BlockSpec((blk[0], 2, nz), lambda i: (i, 0, 0)))
     if modes[2]:
         rz = jnp.concatenate(recvs[2], axis=2)          # (nx, ny, 2)
         operands.append(rz)
-        in_specs.append(pl.BlockSpec((1, ny, 2), lambda i: (i, 0, 0)))
+        in_specs.append(pl.BlockSpec((blk[0], ny, 2), lambda i: (i, 0, 0)))
 
     vma = None
     try:
@@ -364,6 +428,21 @@ def diffusion3d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
         out_shape = jax.ShapeDtypeStruct(T.shape, T.dtype, vma=vma)
     except (AttributeError, TypeError):
         out_shape = jax.ShapeDtypeStruct(T.shape, T.dtype)
+
+    if mp:
+        kernel = partial(_mp_step_recv_kernel, nx=nx, P=P,
+                         modes=tuple(bool(m) for m in modes), **consts)
+        return pl.pallas_call(
+            kernel,
+            grid=(nx // P,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(blk, lambda i: (i, 0, 0)),
+            out_shape=out_shape,
+            scratch_shapes=[pltpu.VMEM((2, P + 2, ny, nz), T.dtype),
+                            pltpu.SemaphoreType.DMA((2,))],
+            interpret=interpret,
+            **_sequential_grid_params(interpret),
+        )(*operands)
 
     kernel = partial(_plane_step_recv_kernel, nx=nx,
                      modes=tuple(bool(m) for m in modes), **consts)
