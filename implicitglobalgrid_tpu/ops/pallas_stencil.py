@@ -564,29 +564,23 @@ def _stencil_row(tm, tc, tp, cp, *, lam, dt, dx, dy):
     return (tc + dt * (acc / cp)).astype(out_dt)
 
 
-def _window_pipeline(T_hbm, scratch, sems, *, nx, B):
+def _window_pipeline_general(ref, scratch, sems, *, size, start_fn):
     """Double-buffered HBM->VMEM window fetch across SEQUENTIAL grid
     programs: program i starts the DMA of window i+1 into the other buffer
     slot before waiting on its own, so the next window's reads ride under
-    this window's compute. Window g covers ``[clip(g*B-1, 0, nx-(B+2)),
-    +B+2)`` along axis 0 (uniform size; clamped at the global edges). The
-    grid MUST run in order — callers pass ``dimension_semantics=
-    ("arbitrary",)``. Returns ``(window_ref, l0)`` where ``window_ref`` is
-    this program's (B+2)-window and ``l0`` is the window index of global
-    position ``i*B``."""
-    import jax.numpy as jnp
+    this window's compute. Window g covers ``[start_fn(g), +size)`` along
+    axis 0 (uniform size). The grid MUST run in order — callers pass
+    ``dimension_semantics=("arbitrary",)``. Returns this program's window
+    ref."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     i = pl.program_id(0)
     nprog = pl.num_programs(0)
 
-    def wstart(g):
-        return jnp.clip(g * B - 1, 0, nx - (B + 2))
-
     def window_dma(slot, g):
         return pltpu.make_async_copy(
-            T_hbm.at[pl.ds(wstart(g), B + 2)], scratch.at[slot],
+            ref.at[pl.ds(start_fn(g), size)], scratch.at[slot],
             sems.at[slot])
 
     @pl.when(i == 0)
@@ -599,7 +593,24 @@ def _window_pipeline(T_hbm, scratch, sems, *, nx, B):
 
     slot = i % 2
     window_dma(slot, i).wait()
-    return scratch.at[slot], i * B - wstart(i)
+    return scratch.at[slot]
+
+
+def _window_pipeline(T_hbm, scratch, sems, *, nx, B):
+    """The stencil kernels' standard window: ``[clip(g*B-1, 0, nx-(B+2)),
+    +B+2)`` (one neighbor plane each side, clamped at the global edges).
+    Returns ``(window_ref, l0)`` where ``l0`` is the window index of global
+    position ``i*B``."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def wstart(g):
+        return jnp.clip(g * B - 1, 0, nx - (B + 2))
+
+    win = _window_pipeline_general(T_hbm, scratch, sems, size=B + 2,
+                                   start_fn=wstart)
+    i = pl.program_id(0)
+    return win, i * B - wstart(i)
 
 
 def _sequential_grid_params(interpret):

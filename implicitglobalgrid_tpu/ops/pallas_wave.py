@@ -160,44 +160,31 @@ def _make_p_get_slab(P, Vx, Vy, Vz, cx, cy, cz, dtK, dx, dy, dz):
     return get
 
 
-def _wave_kernel(*refs, nx, modes, cx, cy, cz, dtK, dx, dy, dz):
-    """One x-plane of the fused step: velocity updates, velocity halo
-    delivery, pressure update from the delivered faces, pressure halo
-    delivery. See module docstring for the ordering argument."""
+def _wave_plane_body(g, nx, p_m, p_c, p_p, vx_c, vx_p, vy_c, vz_c,
+                     rP, rVx, rVy, rVz, *, modes, cx, cy, cz, dtK,
+                     dx, dy, dz):
+    """The fused-step arithmetic for ONE global x-plane ``g``: velocity
+    updates, velocity halo delivery, pressure update from the delivered
+    faces, pressure halo delivery. Shared by the plane-per-program and
+    multi-plane-window kernels. Returns (p_new, vx, vy, vz)."""
     import jax.numpy as jnp
-    from jax.experimental import pallas as pl
 
-    it = iter(refs)
-    p_m, p_c, p_p = (next(it)[0] for _ in range(3))
-    vx_c, vx_p = (next(it)[0] for _ in range(2))
-    vy_c = next(it)[0]
-    vz_c = next(it)[0]
-
-    from .pallas_common import take_recvs
-
-    rP = take_recvs(it, modes, "P", ("x", "y", "z"))
-    rVx = take_recvs(it, modes, "Vx", ("y", "z"))
-    rVy = take_recvs(it, modes, "Vy", ("x", "y", "z"))
-    rVz = take_recvs(it, modes, "Vz", ("x", "y", "z"))
-    oP, oVx, oVy, oVz = refs[-4:]
-
-    i = pl.program_id(0)
     ny, nz = p_c.shape
 
-    # --- velocity updates (interior faces only; x-masks are dynamic in i)
-    vx = jnp.where((i >= 1) & (i <= nx - 1), vx_c + cx * (p_c - p_m), vx_c)
-    vxp = jnp.where(i + 1 <= nx - 1, vx_p + cx * (p_p - p_c), vx_p)
+    # --- velocity updates (interior faces only; x-masks are dynamic in g)
+    vx = jnp.where((g >= 1) & (g <= nx - 1), vx_c + cx * (p_c - p_m), vx_c)
+    vxp = jnp.where(g + 1 <= nx - 1, vx_p + cx * (p_p - p_c), vx_p)
     dyv = p_c[1:, :] - p_c[:-1, :]
     vy = vy_c + cy * jnp.pad(dyv, ((1, 1), (0, 0)))
     dzv = p_c[:, 1:] - p_c[:, :-1]
     vz = vz_c + cz * jnp.pad(dzv, ((0, 0), (1, 1)))
 
     # --- velocity halo delivery (z, x, y; Vx's x planes are post-kernel)
-    vx = _deliver(vx, i, nx, modes["Vx"], None, rVx["y"], rVx["z"],
+    vx = _deliver(vx, g, nx, modes["Vx"], None, rVx["y"], rVx["z"],
                   ny - 1, nz - 1)
-    vy = _deliver(vy, i, nx, modes["Vy"], rVy["x"], rVy["y"], rVy["z"],
+    vy = _deliver(vy, g, nx, modes["Vy"], rVy["x"], rVy["y"], rVy["z"],
                   ny, nz - 1)
-    vz = _deliver(vz, i, nx, modes["Vz"], rVz["x"], rVz["y"], rVz["z"],
+    vz = _deliver(vz, g, nx, modes["Vz"], rVz["x"], rVz["y"], rVz["z"],
                   ny - 1, nz)
 
     # --- pressure update from the DELIVERED faces (vxp undelivered: its
@@ -207,13 +194,129 @@ def _wave_kernel(*refs, nx, modes, cx, cy, cz, dtK, dx, dy, dz):
     divy = (vy[1:, :] - vy[:-1, :]) / dy
     divz = (vz[:, 1:] - vz[:, :-1]) / dz
     p_new = p_c - dtK * (divx + divy + divz)
-    p_new = _deliver(p_new, i, nx, modes["P"], rP["x"], rP["y"], rP["z"],
+    p_new = _deliver(p_new, g, nx, modes["P"], rP["x"], rP["y"], rP["z"],
                      ny - 1, nz - 1)
+    return p_new, vx, vy, vz
 
+
+def _wave_kernel(*refs, nx, modes, cx, cy, cz, dtK, dx, dy, dz):
+    """Plane-per-program form of the fused step (`_wave_plane_body`)."""
+    from jax.experimental import pallas as pl
+
+    from .pallas_common import take_recvs
+
+    it = iter(refs)
+    p_m, p_c, p_p = (next(it)[0] for _ in range(3))
+    vx_c, vx_p = (next(it)[0] for _ in range(2))
+    vy_c = next(it)[0]
+    vz_c = next(it)[0]
+    rP = take_recvs(it, modes, "P", ("x", "y", "z"))
+    rVx = take_recvs(it, modes, "Vx", ("y", "z"))
+    rVy = take_recvs(it, modes, "Vy", ("x", "y", "z"))
+    rVz = take_recvs(it, modes, "Vz", ("x", "y", "z"))
+    oP, oVx, oVy, oVz = refs[-4:]
+
+    i = pl.program_id(0)
+    p_new, vx, vy, vz = _wave_plane_body(
+        i, nx, p_m, p_c, p_p, vx_c, vx_p, vy_c, vz_c, rP, rVx, rVy, rVz,
+        modes=modes, cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dx, dy=dy, dz=dz)
     oP[0] = p_new
     oVx[0] = vx
     oVy[0] = vy
     oVz[0] = vz
+
+
+# The wave kernel keeps more per-plane temporaries live than the diffusion
+# stencil (three P planes, five velocity planes, div terms, p_new, recvs) —
+# its own slack constant, sized above the stencil's 6.
+_WAVE_TEMP_PLANES = 12
+
+
+def wave_mp_planes(p_shape, dtype):
+    """Plane count P for the multi-plane acoustic kernel, or None.
+
+    VMEM model (in P-plane units of the pressure plane): double-buffered
+    manual windows for P (2*(P+2)) and Vx (2*(P+1)), auto-pipelined Vy/Vz
+    input blocks (2P each, slightly larger), and double-buffered outputs
+    for all four fields (~8P) — ~(18P + 6) planes plus temporaries."""
+    from .pallas_stencil import _MP_VMEM_BUDGET, _compute_itemsize
+
+    nx, ny, nz = (int(v) for v in p_shape)
+    import numpy as np
+
+    plane_store = ny * nz * np.dtype(dtype).itemsize
+    plane_compute = ny * nz * _compute_itemsize(np.dtype(dtype))
+    for P in (8, 4):
+        if nx % P or nx < 2 * P:
+            continue
+        if (18 * P + 6) * plane_store \
+                + _WAVE_TEMP_PLANES * plane_compute <= _MP_VMEM_BUDGET:
+            return P
+    return None
+
+
+def _wave_mp_kernel(*refs, nx, P, modes, cx, cy, cz, dtK, dx, dy, dz):
+    """Multi-plane form: P output planes per program; the pressure planes
+    come from a double-buffered (P+2)-window and the Vx faces from a
+    (P+1)-window (faces g0..g0+P — exact, no clamping), cutting their HBM
+    reads from 3x/2x to (1+2/P)x/(1+1/P)x."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from .pallas_stencil import _window_pipeline, _window_pipeline_general
+
+    it = iter(refs)
+    P_hbm = next(it)
+    Vx_hbm = next(it)
+    vy_blk = next(it)                              # (P, ny+1, nz)
+    vz_blk = next(it)                              # (P, ny, nz+1)
+    # x recvs arrive as (2, rows, cols) constants; y/z recvs as
+    # (P, 2, cols)/(P, rows, 2) per-plane blocks — load raw here.
+    got = {}
+    for field, kinds in (("P", ("x", "y", "z")), ("Vx", ("y", "z")),
+                         ("Vy", ("x", "y", "z")), ("Vz", ("x", "y", "z"))):
+        d = {}
+        for k in kinds:
+            if not modes[field][{"x": 0, "y": 1, "z": 2}[k]]:
+                d[k] = None
+                continue
+            d[k] = next(it)[...]
+        got[field] = d
+    # outs (4) precede scratches (4: P window, Vx window, 2 sem arrays)
+    oP, oVx, oVy, oVz = refs[-8:-4]
+    p_scr, vx_scr, p_sems, vx_sems = refs[-4:]
+
+    g0 = pl.program_id(0) * P
+    p_win, l0 = _window_pipeline(P_hbm, p_scr, p_sems, nx=nx, B=P)
+    vx_win = _window_pipeline_general(
+        Vx_hbm, vx_scr, vx_sems, size=P + 1, start_fn=lambda g: g * P)
+
+    def per_plane(field, k, j):
+        r = got[field][k]
+        if r is None:
+            return None
+        return r if k == "x" else r[j]
+
+    for j in range(P):
+        g = g0 + j
+        l = l0 + j
+        p_m = p_win[pl.ds(jnp.maximum(l - 1, 0), 1)][0]
+        p_c = p_win[pl.ds(l, 1)][0]
+        p_p = p_win[pl.ds(jnp.minimum(l + 1, P + 1), 1)][0]
+        vx_c = vx_win[pl.ds(j, 1)][0]
+        vx_p = vx_win[pl.ds(j + 1, 1)][0]
+        rPj = {k: per_plane("P", k, j) for k in ("x", "y", "z")}
+        rVxj = {k: per_plane("Vx", k, j) for k in ("y", "z")}
+        rVyj = {k: per_plane("Vy", k, j) for k in ("x", "y", "z")}
+        rVzj = {k: per_plane("Vz", k, j) for k in ("x", "y", "z")}
+        p_new, vx, vy, vz = _wave_plane_body(
+            g, nx, p_m, p_c, p_p, vx_c, vx_p, vy_blk[j], vz_blk[j],
+            rPj, rVxj, rVyj, rVzj,
+            modes=modes, cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dx, dy=dy, dz=dz)
+        oP[j] = p_new
+        oVx[j] = vx
+        oVy[j] = vy
+        oVz[j] = vz
 
 
 def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
@@ -249,16 +352,29 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
     def spec(shape, index_map):
         return pl.BlockSpec(shape, index_map)
 
-    operands = [P, P, P, Vx, Vx, Vy, Vz]
-    in_specs = [
-        spec((1, ny, nz), lambda i: (jnp.maximum(i - 1, 0), 0, 0)),
-        spec((1, ny, nz), lambda i: (i, 0, 0)),
-        spec((1, ny, nz), lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
-        spec((1, ny, nz), lambda i: (i, 0, 0)),
-        spec((1, ny, nz), lambda i: (i + 1, 0, 0)),
-        spec((1, ny + 1, nz), lambda i: (i, 0, 0)),
-        spec((1, ny, nz + 1), lambda i: (i, 0, 0)),
-    ]
+    Pmp = wave_mp_planes(P.shape, P.dtype)
+    mp = Pmp is not None
+    B = Pmp if mp else 1
+
+    if mp:
+        operands = [P, Vx, Vy, Vz]
+        in_specs = [
+            pl.BlockSpec(memory_space=pl.ANY),      # P: manual DMA window
+            pl.BlockSpec(memory_space=pl.ANY),      # Vx: manual DMA window
+            spec((B, ny + 1, nz), lambda i: (i, 0, 0)),
+            spec((B, ny, nz + 1), lambda i: (i, 0, 0)),
+        ]
+    else:
+        operands = [P, P, P, Vx, Vx, Vy, Vz]
+        in_specs = [
+            spec((1, ny, nz), lambda i: (jnp.maximum(i - 1, 0), 0, 0)),
+            spec((1, ny, nz), lambda i: (i, 0, 0)),
+            spec((1, ny, nz), lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
+            spec((1, ny, nz), lambda i: (i, 0, 0)),
+            spec((1, ny, nz), lambda i: (i + 1, 0, 0)),
+            spec((1, ny + 1, nz), lambda i: (i, 0, 0)),
+            spec((1, ny, nz + 1), lambda i: (i, 0, 0)),
+        ]
 
     from .pallas_common import add_recv_operands, out_shape_with_vma
 
@@ -269,38 +385,61 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
     c0 = lambda i: (0, 0, 0)
     ci = lambda i: (i, 0, 0)
     add_recvs("P", ("x", "y", "z"), [
-        (0, (2, ny, nz), c0), (1, (1, 2, nz), ci), (2, (1, ny, 2), ci)])
+        (0, (2, ny, nz), c0), (1, (B, 2, nz), ci), (2, (B, ny, 2), ci)])
     add_recvs("Vx", ("y", "z"), [
-        (1, (1, 2, nz), ci), (2, (1, ny, 2), ci)])
+        (1, (B, 2, nz), ci), (2, (B, ny, 2), ci)])
     add_recvs("Vy", ("x", "y", "z"), [
-        (0, (2, ny + 1, nz), c0), (1, (1, 2, nz), ci),
-        (2, (1, ny + 1, 2), ci)])
+        (0, (2, ny + 1, nz), c0), (1, (B, 2, nz), ci),
+        (2, (B, ny + 1, 2), ci)])
     add_recvs("Vz", ("x", "y", "z"), [
-        (0, (2, ny, nz + 1), c0), (1, (1, 2, nz + 1), ci),
-        (2, (1, ny, 2), ci)])
+        (0, (2, ny, nz + 1), c0), (1, (B, 2, nz + 1), ci),
+        (2, (B, ny, 2), ci)])
 
     def out_shape_of(a):
         return out_shape_with_vma(a, operands)
 
-    kernel = partial(
-        _wave_kernel, nx=nx,
-        modes={k: tuple(bool(b) for b in v) for k, v in modes.items()},
-        cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dxp, dy=dyp, dz=dzp)
+    kmod = {k: tuple(bool(b) for b in v) for k, v in modes.items()}
+    out_specs = [
+        pl.BlockSpec((B, ny, nz), lambda i: (i, 0, 0)),
+        pl.BlockSpec((B, ny, nz), lambda i: (i, 0, 0)),
+        pl.BlockSpec((B, ny + 1, nz), lambda i: (i, 0, 0)),
+        pl.BlockSpec((B, ny, nz + 1), lambda i: (i, 0, 0)),
+    ]
+    out_shapes = [out_shape_of(P), out_shape_of(Vx), out_shape_of(Vy),
+                  out_shape_of(Vz)]
+    if mp:
+        from jax.experimental.pallas import tpu as pltpu
 
-    Pn, Vxn, Vyn, Vzn = pl.pallas_call(
-        kernel,
-        grid=(nx,),
-        in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, ny, nz), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, ny, nz), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, ny + 1, nz), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, ny, nz + 1), lambda i: (i, 0, 0)),
-        ],
-        out_shape=[out_shape_of(P), out_shape_of(Vx), out_shape_of(Vy),
-                   out_shape_of(Vz)],
-        interpret=interpret,
-    )(*operands)
+        from .pallas_stencil import _sequential_grid_params
+
+        kernel = partial(_wave_mp_kernel, nx=nx, P=Pmp, modes=kmod,
+                         cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dxp, dy=dyp,
+                         dz=dzp)
+        Pn, Vxn, Vyn, Vzn = pl.pallas_call(
+            kernel,
+            grid=(nx // Pmp,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            scratch_shapes=[pltpu.VMEM((2, Pmp + 2, ny, nz), P.dtype),
+                            pltpu.VMEM((2, Pmp + 1, ny, nz), Vx.dtype),
+                            pltpu.SemaphoreType.DMA((2,)),
+                            pltpu.SemaphoreType.DMA((2,))],
+            interpret=interpret,
+            **_sequential_grid_params(interpret),
+        )(*operands)
+    else:
+        kernel = partial(
+            _wave_kernel, nx=nx, modes=kmod,
+            cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dxp, dy=dyp, dz=dzp)
+        Pn, Vxn, Vyn, Vzn = pl.pallas_call(
+            kernel,
+            grid=(nx,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            interpret=interpret,
+        )(*operands)
 
     # The kernel wrote Vx planes 0..nx-1 of the (nx+1)-plane output; plane
     # nx is ALWAYS written here (it would otherwise be uninitialized), and
