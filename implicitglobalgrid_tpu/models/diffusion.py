@@ -177,7 +177,8 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
         gg = global_grid()
         interpret = impl == "pallas_interpret"
         ex_modes = step_exchange_modes(gg, T)
-        if ex_modes is not None and strip_rows_2d(T) is not None:
+        if ex_modes is not None and strip_rows_2d(
+                T, interpret=interpret) is not None:
             # 2-D fused step + exchange (BASELINE config 2): row strips
             # through a double-buffered VMEM window; send slabs from thin
             # XLA slab computes, delivered in the same output pass.

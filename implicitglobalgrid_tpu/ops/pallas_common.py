@@ -7,9 +7,45 @@ the array)."""
 from __future__ import annotations
 
 __all__ = ["slab1", "take_recvs", "add_recv_operands", "out_shape_with_vma",
-           "vx_extra_plane_slabs", "deliver_recvs", "AXIS_OF"]
+           "vx_extra_plane_slabs", "deliver_recvs", "AXIS_OF",
+           "shift_up", "shift_down", "shift_left", "shift_right"]
 
 AXIS_OF = {"x": 0, "y": 1, "z": 2}
+
+
+# Full-size shift operators for kernel-side stencil arithmetic. Mosaic
+# cannot lower `jnp.pad`/concat of values carrying DIFFERENT implicit
+# sublane+lane offsets ("offset mismatch on non-concat dimension" — hit by
+# interior-slice-then-pad formulations); these helpers keep every
+# intermediate at full plane size with offset-0 layouts, cloning the edge
+# row/lane (callers mask the garbage edge through their interior masks).
+
+def shift_up(a):
+    """out[r] = a[r+1]; last row clones a[-1] (garbage — mask it)."""
+    import jax.numpy as jnp
+
+    return jnp.concatenate([a[1:], a[-1:]], axis=0)
+
+
+def shift_down(a):
+    """out[r] = a[r-1]; first row clones a[0] (garbage — mask it)."""
+    import jax.numpy as jnp
+
+    return jnp.concatenate([a[:1], a[:-1]], axis=0)
+
+
+def shift_left(a):
+    """out[:, c] = a[:, c+1]; last lane clones a[:, -1] (garbage)."""
+    import jax.numpy as jnp
+
+    return jnp.concatenate([a[:, 1:], a[:, -1:]], axis=1)
+
+
+def shift_right(a):
+    """out[:, c] = a[:, c-1]; first lane clones a[:, 0] (garbage)."""
+    import jax.numpy as jnp
+
+    return jnp.concatenate([a[:, :1], a[:, :-1]], axis=1)
 
 
 def slab1(A, dim, start):

@@ -489,6 +489,27 @@ def _const_dtype(dtype):
     return dtype.type
 
 
+def _sublane_tile(dtype) -> int:
+    """Rows per native sublane tile: 8 for f32, 16 for bf16 (the (8,128)
+    f32 / (16,128) bf16 TPU tilings). The single source of truth for every
+    alignment gate and the strip kernel's halo-tile height."""
+    import numpy as np
+
+    return max(1, 32 // int(np.dtype(dtype).itemsize))
+
+
+def window_dma_ok(shape, dtype) -> bool:
+    """Whether the manual HBM->VMEM window DMA of `_window_pipeline` is
+    known-good for blocks whose last two dims are ``shape[-2:]``: the copy
+    requires NATIVE-TILE alignment — lane dim a multiple of 128 and sublane
+    dim a multiple of the dtype's sublane tile (8 for f32, 16 for bf16).
+    Mosaic rejects the dynamic-start HBM slice on partially-tiled shapes
+    (verified on v5e: (…, 192)-lane windows fail to compile), so callers
+    must fall back to the BlockSpec-pipelined kernels."""
+    return (int(shape[-1]) % 128 == 0
+            and int(shape[-2]) % _sublane_tile(dtype) == 0)
+
+
 def mp_planes(T):
     """Plane count P for the multi-plane kernel, or None if unsupported.
 
@@ -498,8 +519,9 @@ def mp_planes(T):
     in STORAGE dtype, plus per-plane temporaries slack in COMPUTE dtype
     (bf16 computes in f32). Larger P amortizes the 2-plane window overlap
     (T read amplification 1+2/P); the plane-per-program kernel is the
-    fallback for everything else."""
-    if T.ndim != 3:
+    fallback for everything else (including lane/sublane-unaligned blocks,
+    which the window DMA cannot copy — `window_dma_ok`)."""
+    if T.ndim != 3 or not window_dma_ok(T.shape, T.dtype):
         return None
     cells = int(T.shape[1]) * int(T.shape[2])
     plane_store = cells * T.dtype.itemsize
@@ -751,56 +773,106 @@ def diffusion3d_step_halo_pallas_mp(T, Cp, *, lam, dt, dx, dy, dz, fuse,
 _STRIP2D_CANDIDATES = (256, 128, 64, 32, 16, 8)
 
 
-def strip_rows_2d(T):
+def strip_rows_2d(T, interpret=False):
     """Rows per program R for the 2-D strip kernel, or None if unsupported.
 
-    Working set: double-buffered (R+2)-row T windows plus double-buffered
-    Cp in and out blocks (2R rows each) in STORAGE dtype, plus the
-    shifted-window temporaries of the vectorized strip compute (~6R rows)
-    in COMPUTE dtype (bf16 computes in f32)."""
+    Working set: double-buffered R-row T bodies (+2 halo rows) plus
+    double-buffered Cp in and out blocks (2R rows each) in STORAGE dtype,
+    plus the shifted-window temporaries of the vectorized strip compute
+    (~6R rows) in COMPUTE dtype (bf16 computes in f32). Compiled mode
+    additionally requires native-tile-aligned shapes for the strip DMA
+    (`window_dma_ok`); interpret mode (tests) has no such constraint."""
     if T.ndim != 2:
         return None
     row_store = int(T.shape[1]) * T.dtype.itemsize
     row_compute = int(T.shape[1]) * _compute_itemsize(T.dtype)
+    if not interpret and not window_dma_ok(T.shape, T.dtype):
+        return None
+    sublane = _sublane_tile(T.dtype)
     for R in _STRIP2D_CANDIDATES:
         if T.shape[0] % R or T.shape[0] < 2 * R:
+            continue
+        if R % sublane:
+            # Body slices must start on tile-row boundaries, and the halo
+            # tiles' clamp arithmetic assumes R % H == 0 — in interpret mode
+            # too (the kernel's row picks would silently be wrong otherwise).
             continue
         if (6 * R + 8) * row_store + 6 * R * row_compute <= _MP_VMEM_BUDGET:
             return R
     return None
 
 
-def _strip2d_kernel(*refs, nx, R, modes, lam, dt, dx, dy):
-    """Compute R output rows from an (R+2)-row VMEM window of T (DMA'd with
-    the same cross-program double buffering as `_mp_kernel`), then deliver
-    the received halo slabs: x whole rows first, then y lanes — the exchange
-    order for 2-D blocks (dims 0 then 1 of the z, x, y default; the y slabs
-    carry x's received corners via the slab pipeline's patching). The x-row
-    neighbors inside the window are built as edge-cloned shifts of the whole
-    window and sliced at the strip offset — edge clones only ever reach
-    globally-masked boundary rows (same soundness argument as
-    `_xla_update_slab`)."""
+def _strip2d_kernel(*refs, nx, R, H, modes, lam, dt, dx, dy):
+    """Compute R output rows from a manually DMA'd VMEM strip of T, then
+    deliver the received halo slabs: x whole rows first, then y lanes — the
+    exchange order for 2-D blocks (dims 0 then 1 of the z, x, y default;
+    the y slabs carry x's received corners via the slab pipeline's
+    patching).
+
+    The strip fetch is split into an ALIGNED R-row body plus two H-row halo
+    tiles bracketing it (H = the dtype's sublane tile): 2-D arrays are
+    tiled in BOTH dims, so every HBM slice must be tile-row aligned and
+    sized (Mosaic rejects 1-row slices and dynamic-offset multi-row vector
+    loads alike); the rows just above/below the strip are the last/first
+    rows of those tiles. Tile fetches clamp at the global edges, where the
+    garbage row only reaches globally-masked boundary rows. All three are
+    double-buffered across the sequential grid like `_window_pipeline`;
+    tm/tp are edge-patched shifts of the body."""
     import jax.numpy as jnp
     from jax import lax
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     it = iter(refs)
     T_hbm = next(it)
     cp_ref = next(it)
     rx_ref = next(it) if modes[0] else None       # (2, ny)
     ry_ref = next(it) if modes[1] else None       # (R, 2) strip
-    o_ref = refs[-3]                              # outs precede scratches
-    scratch = refs[-2]
-    sems = refs[-1]
+    o_ref = refs[-5]                              # outs precede scratches
+    body_scr, above_scr, below_scr, sems = refs[-4:]
 
-    win, l0 = _window_pipeline(T_hbm, scratch, sems, nx=nx, B=R)
-    g0 = pl.program_id(0) * R
-    w = win[...]                                   # (R+2, ny)
-    tm_full = jnp.concatenate([w[:1], w[:-1]], axis=0)
-    tp_full = jnp.concatenate([w[1:], w[-1:]], axis=0)
-    tc = lax.dynamic_slice_in_dim(w, l0, R, axis=0)
-    tm = lax.dynamic_slice_in_dim(tm_full, l0, R, axis=0)
-    tp = lax.dynamic_slice_in_dim(tp_full, l0, R, axis=0)
+    i = pl.program_id(0)
+    nprog = pl.num_programs(0)
+
+    def dmas(slot, g):
+        # every start is a multiple of the H-row tile by construction
+        # (R % H == 0, nx % H == 0 — `strip_rows_2d`); Mosaic needs the
+        # explicit hint to slice the row-tiled 2-D memref at a traced index
+        def ds(start, size):
+            return pl.ds(pl.multiple_of(start, H), size)
+
+        return (
+            pltpu.make_async_copy(
+                T_hbm.at[ds(g * R, R)], body_scr.at[slot],
+                sems.at[slot, 0]),
+            pltpu.make_async_copy(
+                T_hbm.at[ds(jnp.maximum(g * R - H, 0), H)],
+                above_scr.at[slot], sems.at[slot, 1]),
+            pltpu.make_async_copy(
+                T_hbm.at[ds(jnp.minimum(g * R + R, nx - H), H)],
+                below_scr.at[slot], sems.at[slot, 2]),
+        )
+
+    @pl.when(i == 0)
+    def _():
+        for d in dmas(0, 0):
+            d.start()
+
+    @pl.when(i + 1 < nprog)
+    def _():
+        for d in dmas((i + 1) % 2, i + 1):
+            d.start()
+
+    slot = i % 2
+    for d in dmas(slot, i):
+        d.wait()
+
+    g0 = i * R
+    tc = body_scr[slot]                                        # (R, ny)
+    row_above = above_scr[slot][H - 1:H]  # last row of the tile ending at g0
+    row_below = below_scr[slot][0:1]   # first row of the tile after the body
+    tm = jnp.concatenate([row_above, tc[:-1]], axis=0)
+    tp = jnp.concatenate([tc[1:], row_below], axis=0)
     upd = _stencil_row(tm, tc, tp, cp_ref[...], lam=lam, dt=dt, dx=dx, dy=dy)
 
     ny = tc.shape[1]
@@ -832,7 +904,7 @@ def diffusion2d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
     from .halo import exchange_recv_slabs
 
     nx, ny = T.shape
-    R = strip_rows_2d(T)
+    R = strip_rows_2d(T, interpret=interpret)
     dtp = _const_dtype(T.dtype)
     consts = dict(lam=dtp(lam), dt=dtp(dt), dx=dtp(dx), dy=dtp(dy))
 
@@ -864,7 +936,8 @@ def diffusion2d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
     except (AttributeError, TypeError):
         out_shape = jax.ShapeDtypeStruct(T.shape, T.dtype)
 
-    kernel = partial(_strip2d_kernel, nx=nx, R=R,
+    H = _sublane_tile(T.dtype)
+    kernel = partial(_strip2d_kernel, nx=nx, R=R, H=H,
                      modes=tuple(bool(m) for m in modes), **consts)
     kwargs = _sequential_grid_params(interpret)
     return pl.pallas_call(
@@ -873,8 +946,10 @@ def diffusion2d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
         in_specs=in_specs,
         out_specs=pl.BlockSpec(blk, lambda i: (i, 0)),
         out_shape=out_shape,
-        scratch_shapes=[pltpu.VMEM((2, R + 2, ny), T.dtype),
-                        pltpu.SemaphoreType.DMA((2,))],
+        scratch_shapes=[pltpu.VMEM((2, R, ny), T.dtype),
+                        pltpu.VMEM((2, H, ny), T.dtype),
+                        pltpu.VMEM((2, H, ny), T.dtype),
+                        pltpu.SemaphoreType.DMA((2, 3))],
         interpret=interpret,
         **kwargs,
     )(*operands)

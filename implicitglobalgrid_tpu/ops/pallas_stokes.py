@@ -128,12 +128,22 @@ def _stokes_kernel(*refs, nx, modes, mu, dt_v, dt_p, damp, dx, dy, dz):
     """One x-plane of the fused PT iteration. Arithmetic mirrors
     `models.stokes._stokes_terms` term-for-term (same accumulation order)
     restricted to this plane; then the interior-masked dV/V updates and the
-    halo deliveries (z, x, y per field; Vx's x planes post-kernel)."""
+    halo deliveries (z, x, y per field; Vx's x planes post-kernel).
+
+    Every intermediate stays at FULL plane size, positioned on a canonical
+    grid and shifted with the edge-cloning operators of `pallas_common`
+    (Mosaic cannot lower interior-slice-then-pad — see `shift_up`); edge
+    garbage only ever reaches rows/lanes the interior masks cut away.
+    Canonical grids: cell quantities on (ny, nz); x-y edge stresses
+    ``txyE[e] = txy(edge e-1/2)`` on (ny, nz); x-z edges ``txzE[:, f]`` on
+    (ny, nz); y-z edges ``tyzE[f, g]`` on (ny, nz) (valid from index 1 in
+    each edge direction)."""
     import jax.numpy as jnp
     from jax import lax
     from jax.experimental import pallas as pl
 
     from .pallas_common import deliver_recvs as _deliver
+    from .pallas_common import shift_down, shift_left, shift_right, shift_up
 
     it = iter(refs)
     p_m, p_c = (next(it)[0] for _ in range(2))
@@ -156,7 +166,7 @@ def _stokes_kernel(*refs, nx, modes, mu, dt_v, dt_p, damp, dx, dy, dz):
     i = pl.program_id(0)
     ny, nz = p_c.shape
 
-    def d_y(a):
+    def d_y(a):  # cell-centred face difference (full size: (ny+1,.) -> (ny,.))
         return a[1:, :] - a[:-1, :]
 
     def d_z(a):
@@ -171,22 +181,28 @@ def _stokes_kernel(*refs, nx, modes, mu, dt_v, dt_p, damp, dx, dy, dz):
     txxm = 2 * mu * ((vxc - vxm) / dx - divm / 3)
     tyyc = 2 * mu * (d_y(vyc) / dy - divc / 3)
     tzzc = 2 * mu * (d_z(vzc) / dz - divc / 3)
-    # edge stresses: _f at x-edge carried by face i, _fp by face i+1
-    txy_f = mu * (d_y(vxc) / dy + ((vyc - vym) / dx)[1:-1, :])
-    txy_fp = mu * (d_y(vxp) / dy + ((vyp - vyc) / dx)[1:-1, :])
-    txz_f = mu * (d_z(vxc) / dz + ((vzc - vzm) / dx)[:, 1:-1])
-    txz_fp = mu * (d_z(vxp) / dz + ((vzp - vzc) / dx)[:, 1:-1])
-    tyz_c = mu * (d_z(vyc)[1:-1, :] / dz + d_y(vzc)[:, 1:-1] / dy)
+    # edge stresses on canonical full-size grids: txyE[e] at y-edge e-1/2 of
+    # the x-edge carried by face i; txyEp at face i+1 (valid rows e >= 1)
+    txyE = mu * ((vxc - shift_down(vxc)) / dy + (vyc - vym)[:ny] / dx)
+    txyEp = mu * ((vxp - shift_down(vxp)) / dy + (vyp - vyc)[:ny] / dx)
+    txzE = mu * ((vxc - shift_right(vxc)) / dz + (vzc - vzm)[:, :nz] / dx)
+    txzEp = mu * ((vxp - shift_right(vxp)) / dz + (vzp - vzc)[:, :nz] / dx)
+    tyzE = mu * ((vyc - shift_right(vyc))[:ny] / dz
+                 + (vzc - shift_down(vzc))[:, :nz] / dy)
 
-    Rx = (((txxc - pnc) - (txxm - pnm))[1:-1, 1:-1] / dx
-          + d_y(txy_f)[:, 1:-1] / dy
-          + d_z(txz_f)[1:-1, :] / dz)                       # (ny-2, nz-2)
-    Ry = ((d_y(tyyc - pnc) / dy + (txy_fp - txy_f) / dx)[:, 1:-1]
-          + d_z(tyz_c) / dz)                                # (ny-1, nz-2)
-    rgf = 0.5 * (d_z(rhc) + 2 * rhc[:, :-1])                # (ny, nz-1)
-    Rz = ((d_z(tzzc - pnc) / dz + (txz_fp - txz_f) / dx)[1:-1, :]
-          + d_y(tyz_c) / dy
-          + rgf[1:-1, :])                                   # (ny-2, nz-1)
+    # residuals, full size (same accumulation order as `_stokes_terms`):
+    # RxF on cells (valid 1..ny-2, 1..nz-2), RyF on y-faces 1..ny-1 (cell
+    # cols 1..nz-2), RzF on z-faces 1..nz-1 (cell rows 1..ny-2)
+    RxF = (((txxc - pnc) - (txxm - pnm)) / dx
+           + (shift_up(txyE) - txyE) / dy
+           + (shift_left(txzE) - txzE) / dz)
+    Ty = tyyc - pnc
+    RyF = ((Ty - shift_down(Ty)) / dy + (txyEp - txyE) / dx
+           + (shift_left(tyzE) - tyzE) / dz)
+    Tz = tzzc - pnc
+    RzF = ((Tz - shift_right(Tz)) / dz + (txzEp - txzE) / dx
+           + (shift_up(tyzE) - tyzE) / dy
+           + 0.5 * (rhc + shift_right(rhc)))
 
     # --- interior-masked damped-momentum + velocity updates ---------------
     row = lax.broadcasted_iota(jnp.int32, (ny, nz), 0)
@@ -199,17 +215,17 @@ def _stokes_kernel(*refs, nx, modes, mu, dt_v, dt_p, damp, dx, dy, dz):
     cell_ok = (i >= 1) & (i <= nx - 2)
 
     mx = face_ok & (row > 0) & (row < ny - 1) & (col > 0) & (col < nz - 1)
-    dnx = damp * dvxc + jnp.pad(Rx, ((1, 1), (1, 1)))
+    dnx = damp * dvxc + RxF
     u_dvx = jnp.where(mx, dnx, dvxc)
     u_vx = jnp.where(mx, vxc + dt_v * dnx, vxc)
 
     my = cell_ok & (rowy > 0) & (rowy < ny) & (coly > 0) & (coly < nz - 1)
-    dny = damp * dvyc + jnp.pad(Ry, ((1, 1), (1, 1)))
+    dny = damp * dvyc + jnp.concatenate([RyF, RyF[-1:]], axis=0)
     u_dvy = jnp.where(my, dny, dvyc)
     u_vy = jnp.where(my, vyc + dt_v * dny, vyc)
 
     mz = cell_ok & (rowz > 0) & (rowz < ny - 1) & (colz > 0) & (colz < nz)
-    dnz = damp * dvzc + jnp.pad(Rz, ((1, 1), (1, 1)))
+    dnz = damp * dvzc + jnp.concatenate([RzF, RzF[:, -1:]], axis=1)
     u_dvz = jnp.where(mz, dnz, dvzc)
     u_vz = jnp.where(mz, vzc + dt_v * dnz, vzc)
 

@@ -238,12 +238,18 @@ def wave_mp_planes(p_shape, dtype):
     VMEM model (in P-plane units of the pressure plane): double-buffered
     manual windows for P (2*(P+2)) and Vx (2*(P+1)), auto-pipelined Vy/Vz
     input blocks (2P each, slightly larger), and double-buffered outputs
-    for all four fields (~8P) — ~(18P + 6) planes plus temporaries."""
-    from .pallas_stencil import _MP_VMEM_BUDGET, _compute_itemsize
+    for all four fields (~8P) — ~(18P + 6) planes plus temporaries.
+    Lane/sublane-unaligned planes cannot use the manual window DMA
+    (`pallas_stencil.window_dma_ok`) and take the plane-per-program form."""
+    from .pallas_stencil import (
+        _MP_VMEM_BUDGET, _compute_itemsize, window_dma_ok,
+    )
 
     nx, ny, nz = (int(v) for v in p_shape)
     import numpy as np
 
+    if not window_dma_ok((ny, nz), dtype):
+        return None
     plane_store = ny * nz * np.dtype(dtype).itemsize
     plane_compute = ny * nz * _compute_itemsize(np.dtype(dtype))
     for P in (8, 4):
@@ -271,13 +277,16 @@ def _wave_mp_kernel(*refs, nx, P, modes, cx, cy, cz, dtK, dx, dy, dz):
     vy_blk = next(it)                              # (P, ny+1, nz)
     vz_blk = next(it)                              # (P, ny, nz+1)
     # x recvs arrive as (2, rows, cols) constants; y/z recvs as
-    # (P, 2, cols)/(P, rows, 2) per-plane blocks — load raw here.
+    # (P, 2, cols)/(P, rows, 2) per-plane blocks — load raw here (same
+    # field/kind iteration order as `add_recv_operands`/`take_recvs`).
+    from .pallas_common import AXIS_OF
+
     got = {}
     for field, kinds in (("P", ("x", "y", "z")), ("Vx", ("y", "z")),
                          ("Vy", ("x", "y", "z")), ("Vz", ("x", "y", "z"))):
         d = {}
         for k in kinds:
-            if not modes[field][{"x": 0, "y": 1, "z": 2}[k]]:
+            if not modes[field][AXIS_OF[k]]:
                 d[k] = None
                 continue
             d[k] = next(it)[...]
