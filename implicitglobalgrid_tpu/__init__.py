@@ -48,7 +48,7 @@ from .tools import (
     nx_g, ny_g, nz_g, x_g, y_g, z_g, x_g_vec, y_g_vec, z_g_vec, coords_g,
 )
 from .utils.timing import tic, toc, barrier, sync
-from .utils.profiling import trace, annotate
+from .utils.profiling import trace, annotate, overlap_stats, op_breakdown
 from .utils.checkpoint import save_checkpoint, restore_checkpoint, load_checkpoint
 from .utils import exceptions
 
@@ -60,7 +60,7 @@ __all__ = [
     "select_device", "nx_g", "ny_g", "nz_g", "x_g", "y_g", "z_g", "tic", "toc",
     # TPU-native extensions
     "local_update_halo", "hide_communication", "gather_interior", "gather_sub", "barrier",
-    "sync", "trace", "annotate",
+    "sync", "trace", "annotate", "overlap_stats", "op_breakdown",
     "zeros_g", "ones_g", "full_g", "device_put_g", "sharding_of",
     "Field", "wrap_field", "extract", "local_shape_of", "stacked_shape",
     "x_g_vec", "y_g_vec", "z_g_vec", "coords_g",

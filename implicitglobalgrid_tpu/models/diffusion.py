@@ -140,7 +140,7 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
             # plane resourcing — no slab materialization at all). The
             # multi-plane kernel cuts T read traffic ~2.4x where its shape
             # gates pass.
-            if mp_supported(T):
+            if mp_supported(T, interpret=interpret):
                 return diffusion3d_step_halo_pallas_mp(T, Cp, fuse=fuse, **kw)
             return diffusion3d_step_halo_pallas(T, Cp, fuse=fuse, **kw)
         ex_modes = step_exchange_modes(gg, T)
@@ -155,7 +155,7 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
             # fuses in-kernel; a later dim is nonstandard): exchange only
             # the REMAINING dims afterwards — the suffix of the order, so
             # the reference's sequential-corner semantics hold.
-            if mp_supported(T):
+            if mp_supported(T, interpret=interpret):
                 T = diffusion3d_step_halo_pallas_mp(T, Cp, fuse=fuse, **kw)
             else:
                 T = diffusion3d_step_halo_pallas(T, Cp, fuse=fuse, **kw)
@@ -163,7 +163,7 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
 
             rem = tuple(d for d in DEFAULT_DIMS_ORDER if not fuse[d])
             return local_update_halo(T, dims=rem)
-        if mp_supported(T):
+        if mp_supported(T, interpret=interpret):
             T = diffusion3d_step_halo_pallas_mp(
                 T, Cp, fuse=(False, False, False), **kw)
         else:

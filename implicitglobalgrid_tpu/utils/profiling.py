@@ -5,34 +5,42 @@ and keeps its streams/tasks persistent partly so external profilers can see
 the overlap structure (`src/update_halo.jl:207` note). On TPU the profiler IS
 the external tool: `jax.profiler` captures an XLA trace (HLO ops, fusion
 boundaries, collective overlap, HBM traffic) viewable in XProf/TensorBoard or
-Perfetto. This module wraps it with the framework's naming conventions:
+Perfetto. This module wraps it with the framework's naming conventions AND
+analyzes the capture in-process (`utils/xplane.py` decodes the profile
+protobuf directly), so comm/compute overlap is a NUMBER the framework can
+report, not a screenshot:
 
     with igg.trace("/tmp/igg_trace"):
-        T = run_diffusion(T, Cp, p, nt)          # whole hot loop captured
+        T = igg.sync(run_diffusion(T, Cp, p, nt))  # whole hot loop captured
 
-    with igg.annotate("halo_z"):                  # named region in the trace
-        A = igg.update_halo(A)
+    stats = igg.overlap_stats("/tmp/igg_trace")
+    # {'TPU:0': {'busy_us': ..., 'comm_us': ..., 'hidden_comm_us': ...,
+    #            'exposed_comm_us': ..., 'overlap_frac': ...}, ...}
 
-The capture contains the per-axis `ppermute` collectives and the Pallas
-kernels by name — the direct analog of inspecting the reference's
-max-priority-stream overlap in Nsight.
+    igg.op_breakdown("/tmp/igg_trace")   # top ops by device time
+
+`overlap_stats` is the quantitative analog of inspecting the reference's
+max-priority-stream overlap in Nsight: collectives (`collective-permute` =
+the exchange's ppermutes, plus all-reduce/all-gather) are attributed from
+the device planes' "XLA Ops"/"Async XLA Ops" lines; async collective spans
+that run concurrently with compute intervals count as HIDDEN communication.
 """
 
 from __future__ import annotations
 
 import contextlib
+import re
 
-__all__ = ["trace", "annotate"]
+__all__ = ["trace", "annotate", "overlap_stats", "op_breakdown"]
 
 
 @contextlib.contextmanager
 def trace(log_dir: str, *, create_perfetto_link: bool = False):
     """Capture a `jax.profiler` trace of the enclosed block into ``log_dir``.
 
-    The block's dispatched work is drained (`sync`-style barrier via
-    `jax.block_until_ready` on the profiler's own bookkeeping is NOT enough —
-    callers should pass their outputs through `igg.sync` before exiting the
-    block so trailing device work lands inside the capture window).
+    Pass the block's outputs through `igg.sync` before exiting so trailing
+    device work lands inside the capture window. Analyze the capture with
+    `overlap_stats`/`op_breakdown`, or open it in XProf/TensorBoard.
     """
     import jax
 
@@ -46,3 +54,149 @@ def annotate(name: str):
     import jax
 
     return jax.profiler.TraceAnnotation(name)
+
+
+# HLO ops that move data between devices. `collective-permute` is the
+# exchange's wire op (one pair per axis — tests/test_hlo_audit.py); the rest
+# guard against hidden collectives sneaking into a "local" program.
+_COMM_RE = re.compile(
+    r"collective-permute|all-reduce|all-gather|all-to-all|reduce-scatter"
+    r"|ppermute|send|recv", re.IGNORECASE)
+
+_OP_KIND_RE = re.compile(r"\s([a-z][a-z0-9._-]*)\(")
+
+
+def _op_kind(name: str) -> str:
+    """Short op kind from an HLO event name ('%fusion.3 = f32[…] fusion(…)'
+    -> 'fusion'); module-level events ('jit_step(123…)') keep their title.
+
+    Tuple-typed ops ('%f = (f32[…], f32[…]) fusion(…)') put spaces inside
+    the type, so the kind is located as the last lowercase token before a
+    '(' AFTER skipping a parenthesized tuple type when present."""
+    rhs = name.split(" = ", 1)[-1]
+    if rhs.startswith("("):  # tuple type: skip to its closing paren
+        depth = 0
+        for i, ch in enumerate(rhs):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    rhs = rhs[i + 1:]
+                    break
+    m = _OP_KIND_RE.search(" " + rhs)
+    if m:
+        return m.group(1)
+    return name.split("(")[0].strip() or name
+
+
+def _device_planes(log_dir: str):
+    from .xplane import find_xplane_files, parse_xspace
+
+    planes = []
+    for path in find_xplane_files(log_dir):
+        for plane in parse_xspace(path):
+            if plane.name.startswith("/device:"):
+                planes.append(plane)
+    return planes
+
+
+def _merge(intervals):
+    """Union of [start, end) intervals; returns merged list and total."""
+    if not intervals:
+        return [], 0
+    intervals = sorted(intervals)
+    out = [list(intervals[0])]
+    for s, e in intervals[1:]:
+        if s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return out, sum(e - s for s, e in out)
+
+
+def _intersect_total(a, b):
+    """Total overlap between two MERGED interval lists."""
+    i = j = 0
+    total = 0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            total += e - s
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def overlap_stats(log_dir: str):
+    """Comm/compute overlap numbers per device plane of the NEWEST capture
+    under ``log_dir``.
+
+    For each `/device:*` plane: compute intervals come from the non-comm
+    events of every op line; comm intervals from events matching the
+    collective patterns on any line — crucially including the "Async XLA
+    Ops" line, where an async collective's event SPANS start→done, so the
+    span's intersection with compute intervals measures communication the
+    scheduler actually hid (the XLA analog of the reference overlapping
+    its pack kernels and MPI traffic with user kernels on max-priority
+    streams). Returns ``{device_name: {busy_us, compute_us, comm_us,
+    hidden_comm_us, exposed_comm_us, overlap_frac}}``; an empty dict means
+    no device plane was captured."""
+    out = {}
+    for plane in _device_planes(log_dir):
+        comm = []
+        compute = []
+        for line in plane.lines:
+            if line.name in ("XLA Modules", "Steps", "Framework Ops",
+                             "TC Overlay"):
+                continue  # containers duplicating the op lines
+            # Comm events are recognized on EVERY op line (async collective
+            # spans live on "Async XLA Ops"); compute intervals come ONLY
+            # from the synchronous "XLA Ops" line — a non-collective async
+            # span (copy-start, host offload DMA) is not core compute, and
+            # counting it would inflate hidden_comm when a collective
+            # merely overlaps another DMA while the core sits idle.
+            for ev in line.events:
+                if ev.duration_ps <= 0:
+                    continue
+                iv = (ev.start_ps, ev.end_ps)
+                if _COMM_RE.search(ev.name):
+                    comm.append(iv)
+                elif line.name == "XLA Ops":
+                    compute.append(iv)
+        comm_m, comm_total = _merge(comm)
+        comp_m, comp_total = _merge(compute)
+        busy = _merge(comm + compute)[1]
+        hidden = _intersect_total(comm_m, comp_m)
+        name = plane.name.replace("/device:", "")
+        out[name] = {
+            "busy_us": busy / 1e6,
+            "compute_us": comp_total / 1e6,
+            "comm_us": comm_total / 1e6,
+            "hidden_comm_us": hidden / 1e6,
+            "exposed_comm_us": (comm_total - hidden) / 1e6,
+            "overlap_frac": hidden / comm_total if comm_total else None,
+        }
+    return out
+
+
+def op_breakdown(log_dir: str, top: int = 12):
+    """Aggregate device time by op kind over the NEWEST capture under
+    ``log_dir``: ``[(kind, total_us, count), …]`` sorted by time. Fusions
+    appear as 'fusion', the exchange's wire ops as 'collective-permute*',
+    Pallas kernels as 'custom-call' (Mosaic kernels are custom calls)."""
+    agg: dict = {}
+    for plane in _device_planes(log_dir):
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                kind = _op_kind(ev.name)
+                t, c = agg.get(kind, (0, 0))
+                agg[kind] = (t + ev.duration_ps, c + 1)
+    rows = sorted(((k, t / 1e6, c) for k, (t, c) in agg.items()),
+                  key=lambda r: -r[1])
+    return rows[:top]

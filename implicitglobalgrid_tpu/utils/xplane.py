@@ -1,0 +1,190 @@
+"""Minimal pure-Python reader for the XLA profiler's XSpace/XPlane protos.
+
+`jax.profiler.trace` writes its capture as
+``<log_dir>/plugins/profile/<run>/<host>.xplane.pb`` — an ``XSpace`` protobuf
+(the TensorBoard/XProf exchange format). The usual consumers are external
+GUIs; this module decodes the wire format directly (no tensorflow/protobuf
+dependency) so the framework can compute numbers from its own traces —
+the quantitative upgrade over the reference's approach of structuring its
+CUDA streams for external Nsight inspection
+(`/root/reference/src/update_halo.jl:207` note).
+
+Only the fields the analysis needs are decoded:
+
+    XSpace.planes[]                                 (field 1)
+      XPlane: name=2, lines=3, event_metadata=4     (map<id, XEventMetadata>)
+        XLine: name=2, timestamp_ns=3, events=4, display_name=11
+          XEvent: metadata_id=1, offset_ps=2, duration_ps=3
+        XEventMetadata: id=1, name=2, display_name=4
+
+Everything else (stats, reference events) is skipped structurally, so the
+parser stays correct as the schema grows.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass, field
+
+__all__ = ["XEvent", "XLine", "XPlane", "parse_xspace", "find_xplane_files"]
+
+
+@dataclass
+class XEvent:
+    name: str
+    start_ps: int       # absolute within the plane (line timestamp + offset)
+    duration_ps: int
+
+    @property
+    def end_ps(self) -> int:
+        return self.start_ps + self.duration_ps
+
+
+@dataclass
+class XLine:
+    name: str
+    timestamp_ns: int
+    events: list = field(default_factory=list)
+
+
+@dataclass
+class XPlane:
+    name: str
+    lines: list = field(default_factory=list)
+
+
+def _varint(buf: bytes, i: int):
+    r = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, i
+        s += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message's wire bytes."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        fn, wt = tag >> 3, tag & 7
+        if wt == 0:                       # varint
+            v, i = _varint(buf, i)
+        elif wt == 2:                     # length-delimited
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:                     # fixed32
+            v = struct.unpack_from("<I", buf, i)[0]
+            i += 4
+        elif wt == 1:                     # fixed64
+            v = struct.unpack_from("<Q", buf, i)[0]
+            i += 8
+        else:  # groups (3/4) never appear in xplane protos
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fn, wt, v
+
+
+def _parse_event_metadata(buf: bytes):
+    """XEventMetadata -> (id, best-effort name)."""
+    mid = None
+    name = None
+    display = None
+    for fn, _, v in _fields(buf):
+        if fn == 1:
+            mid = v
+        elif fn == 2:
+            name = v.decode("utf-8", "replace")
+        elif fn == 4:
+            display = v.decode("utf-8", "replace")
+    return mid, (display or name or "")
+
+
+def _parse_line(buf: bytes, names: dict):
+    name = ""
+    display = ""
+    timestamp_ns = 0
+    raw_events = []
+    for fn, _, v in _fields(buf):
+        if fn == 2:
+            name = v.decode("utf-8", "replace")
+        elif fn == 11:
+            display = v.decode("utf-8", "replace")
+        elif fn == 3:
+            timestamp_ns = v
+        elif fn == 4:
+            raw_events.append(v)
+    line = XLine(name=display or name, timestamp_ns=timestamp_ns)
+    base_ps = timestamp_ns * 1000
+    for ev in raw_events:
+        mid = 0
+        off_ps = 0
+        dur_ps = 0
+        for fn, _, v in _fields(ev):
+            if fn == 1:
+                mid = v
+            elif fn == 2:
+                off_ps = v
+            elif fn == 3:
+                dur_ps = v
+        line.events.append(
+            XEvent(name=names.get(mid, str(mid)), start_ps=base_ps + off_ps,
+                   duration_ps=dur_ps))
+    return line
+
+
+def _parse_plane(buf: bytes):
+    name = ""
+    raw_lines = []
+    names: dict = {}
+    for fn, _, v in _fields(buf):
+        if fn == 2:
+            name = v.decode("utf-8", "replace")
+        elif fn == 3:
+            raw_lines.append(v)
+        elif fn == 4:  # map<int64, XEventMetadata>: entry{key=1, value=2}
+            key = None
+            meta = None
+            for f2, _, v2 in _fields(v):
+                if f2 == 1:
+                    key = v2
+                elif f2 == 2:
+                    meta = v2
+            if meta is not None:
+                mid, mname = _parse_event_metadata(meta)
+                names[mid if mid is not None else key] = mname
+    plane = XPlane(name=name)
+    for ln in raw_lines:
+        plane.lines.append(_parse_line(ln, names))
+    return plane
+
+
+def parse_xspace(path: str):
+    """Parse one ``*.xplane.pb`` file into a list of `XPlane`s."""
+    with open(path, "rb") as f:
+        data = f.read()
+    planes = []
+    for fn, wt, v in _fields(data):
+        if fn == 1 and wt == 2:
+            planes.append(_parse_plane(v))
+    return planes
+
+
+def find_xplane_files(log_dir: str):
+    """``*.xplane.pb`` files of the NEWEST run under a `jax.profiler.trace`
+    log directory (captures land in ``plugins/profile/<timestamp>/``)."""
+    root = os.path.join(log_dir, "plugins", "profile")
+    if not os.path.isdir(root):
+        return []
+    runs = sorted(d for d in os.listdir(root)
+                  if os.path.isdir(os.path.join(root, d)))
+    if not runs:
+        return []
+    run_dir = os.path.join(root, runs[-1])
+    return sorted(os.path.join(run_dir, f) for f in os.listdir(run_dir)
+                  if f.endswith(".xplane.pb"))

@@ -1,10 +1,143 @@
-"""Profiling helpers: trace capture produces artifacts, annotate nests."""
+"""Profiling subsystem: trace capture, the pure-Python XPlane decoder, and
+the comm/compute overlap analysis (the quantitative analog of the
+reference's structure-the-streams-for-Nsight approach,
+`/root/reference/src/update_halo.jl:207`)."""
 
 import os
 
 import numpy as np
 
 import implicitglobalgrid_tpu as igg
+
+
+def _varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _field(fn: int, wt: int, payload) -> bytes:
+    tag = _varint(fn << 3 | wt)
+    if wt == 0:
+        return tag + _varint(payload)
+    return tag + _varint(len(payload)) + payload
+
+
+def _event(mid, offset_ps, dur_ps):
+    return (_field(1, 0, mid) + _field(2, 0, offset_ps)
+            + _field(3, 0, dur_ps))
+
+
+def _line(name, ts_ns, events):
+    body = _field(2, 2, name.encode()) + _field(3, 0, ts_ns)
+    for ev in events:
+        body += _field(4, 2, ev)
+    return body
+
+
+def _meta(mid, name):
+    return _field(1, 0, mid) + _field(2, 2, name.encode())
+
+
+def _plane(name, lines, metas):
+    body = _field(2, 2, name.encode())
+    for ln in lines:
+        body += _field(3, 2, ln)
+    for mid, m in metas:
+        body += _field(4, 2, _field(1, 0, mid) + _field(2, 2, m))
+    return body
+
+
+def test_xplane_wire_decoder(tmp_path):
+    """Decode a hand-encoded XSpace: plane/line/event names, metadata
+    resolution, and line-timestamp + offset arithmetic."""
+    from implicitglobalgrid_tpu.utils.xplane import parse_xspace
+
+    metas = [(1, _meta(1, "%f = f32[8]{0} fusion(%a), calls=%fc")),
+             (2, _meta(2, "%cp = collective-permute-start(%x)"))]
+    lines = [
+        _line("XLA Ops", 10, [_event(1, 5000, 2000)]),
+        _line("Async XLA Ops", 10, [_event(2, 6000, 4000)]),
+    ]
+    space = _field(1, 2, _plane("/device:TPU:0", lines, metas))
+    p = tmp_path / "t.xplane.pb"
+    p.write_bytes(space)
+
+    planes = parse_xspace(str(p))
+    assert len(planes) == 1 and planes[0].name == "/device:TPU:0"
+    ops, async_ops = planes[0].lines
+    assert ops.name == "XLA Ops" and async_ops.name == "Async XLA Ops"
+    (ev,) = ops.events
+    assert "fusion" in ev.name
+    assert ev.start_ps == 10 * 1000 + 5000 and ev.duration_ps == 2000
+    (aev,) = async_ops.events
+    assert "collective-permute" in aev.name
+
+
+def _write_run(tmp_path, planes_bytes):
+    run = tmp_path / "plugins" / "profile" / "run1"
+    run.mkdir(parents=True)
+    blob = b"".join(_field(1, 2, p) for p in planes_bytes)
+    (run / "host.xplane.pb").write_bytes(blob)
+
+
+def test_overlap_stats_arithmetic(tmp_path):
+    """overlap_stats on a synthetic capture: compute [15, 17)us and an
+    async collective span [16, 20)us -> 1us of the 4us comm hidden."""
+    from implicitglobalgrid_tpu.utils.profiling import overlap_stats
+
+    metas = [(1, _meta(1, "%f = f32[8]{0} fusion(%a), calls=%fc")),
+             (2, _meta(2, "%cp = collective-permute-start(%x)")),
+             (3, _meta(3, "%cs = (f32[8]{0}, u32[]) copy-start(%a)"))]
+    lines = [
+        _line("XLA Ops", 0, [_event(1, 15_000_000, 2_000_000)]),
+        # the async copy span is NOT compute: it must not count toward
+        # hidden communication (the core is idle under it)
+        _line("Async XLA Ops", 0, [_event(2, 16_000_000, 4_000_000),
+                                   _event(3, 18_000_000, 9_000_000)]),
+    ]
+    _write_run(tmp_path, [_plane("/device:TPU:0", lines, metas)])
+
+    stats = overlap_stats(str(tmp_path))
+    s = stats["TPU:0"]
+    assert abs(s["compute_us"] - 2.0) < 1e-9
+    assert abs(s["comm_us"] - 4.0) < 1e-9
+    assert abs(s["hidden_comm_us"] - 1.0) < 1e-9
+    assert abs(s["exposed_comm_us"] - 3.0) < 1e-9
+    assert abs(s["overlap_frac"] - 0.25) < 1e-9
+    assert abs(s["busy_us"] - 5.0) < 1e-9  # union [15,17) u [16,20)
+
+
+def test_op_breakdown_synthetic(tmp_path):
+    from implicitglobalgrid_tpu.utils.profiling import op_breakdown
+
+    metas = [(1, _meta(1, "%f = f32[8]{0} fusion(%a), calls=%fc")),
+             (3, _meta(3, "%d = f32[8]{0} copy-done(%cs)"))]
+    lines = [_line("XLA Ops", 0, [_event(1, 0, 3000), _event(1, 5000, 1000),
+                                  _event(3, 9000, 500)])]
+    _write_run(tmp_path, [_plane("/device:TPU:0", lines, metas)])
+
+    rows = op_breakdown(str(tmp_path))
+    assert rows[0][0] == "fusion" and rows[0][2] == 2
+    assert any(k == "copy-done" for k, _, _ in rows)
+
+
+def test_op_kind_parsing():
+    from implicitglobalgrid_tpu.utils.profiling import _op_kind
+
+    assert _op_kind("%f.1 = f32[512,512]{1,0:T(8,128)} fusion(%a)") == "fusion"
+    # tuple-typed ops (multi-output fusions, async starts) aggregate too
+    assert _op_kind("%f = (f32[8]{0}, f32[8]{0}) fusion(%a, %b)") == "fusion"
+    assert _op_kind(
+        "%cs = (f32[8]{0}, u32[]) collective-permute-start(%x)"
+    ) == "collective-permute-start"
+    assert _op_kind("jit_matmul(123456)") == "jit_matmul"
+    assert _op_kind("while.3") == "while.3"
 
 
 def test_trace_and_annotate(tmp_path):
@@ -17,4 +150,12 @@ def test_trace_and_annotate(tmp_path):
     # the profiler wrote something under the log dir
     found = [p for _, _, fs in os.walk(tmp_path) for p in fs]
     assert found, "profiler trace produced no files"
+    # the decoder reads the real capture; device planes exist only when an
+    # accelerator backend registered — assert the analysis is well-formed
+    # either way (values finite, hidden comm bounded by total comm)
+    stats = igg.overlap_stats(str(tmp_path))
+    for s in stats.values():
+        assert s["busy_us"] >= 0 and s["comm_us"] >= 0
+        assert s["hidden_comm_us"] <= s["comm_us"] + 1e-9
+    igg.op_breakdown(str(tmp_path))
     igg.finalize_global_grid()
