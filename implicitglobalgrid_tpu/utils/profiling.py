@@ -87,17 +87,34 @@ def _op_kind(name: str) -> str:
     m = _OP_KIND_RE.search(" " + rhs)
     if m:
         return m.group(1)
-    return name.split("(")[0].strip() or name
+    # short-form names (real captures emit e.g. 'copy.15', 'fusion.35'):
+    # drop the instruction suffix so kinds aggregate
+    short = name.split("(")[0].strip() or name
+    return re.sub(r"\.\d+$", "", short)
+
+
+_planes_cache: dict = {}
 
 
 def _device_planes(log_dir: str):
+    """Device planes of the newest capture; memoized on the capture files'
+    (path, mtime, size) so overlap_stats + op_breakdown on the same trace
+    decode the (potentially large) protobuf once."""
+    import os
+
     from .xplane import find_xplane_files, parse_xspace
 
+    files = find_xplane_files(log_dir)
+    key = tuple((p, os.path.getmtime(p), os.path.getsize(p)) for p in files)
+    hit = _planes_cache.get(log_dir)
+    if hit is not None and hit[0] == key:
+        return hit[1]
     planes = []
-    for path in find_xplane_files(log_dir):
+    for path in files:
         for plane in parse_xspace(path):
             if plane.name.startswith("/device:"):
                 planes.append(plane)
+    _planes_cache[log_dir] = (key, planes)
     return planes
 
 
@@ -163,7 +180,9 @@ def overlap_stats(log_dir: str):
                 if ev.duration_ps <= 0:
                     continue
                 iv = (ev.start_ps, ev.end_ps)
-                if _COMM_RE.search(ev.name):
+                # classify by the OP KIND, not the full HLO text — a fusion
+                # consuming '%collective-permute-done.2' is compute, not comm
+                if _COMM_RE.search(_op_kind(ev.name)):
                     comm.append(iv)
                 elif line.name == "XLA Ops":
                     compute.append(iv)

@@ -137,7 +137,21 @@ def test_op_kind_parsing():
         "%cs = (f32[8]{0}, u32[]) collective-permute-start(%x)"
     ) == "collective-permute-start"
     assert _op_kind("jit_matmul(123456)") == "jit_matmul"
-    assert _op_kind("while.3") == "while.3"
+    # short-form names from real captures aggregate by kind
+    assert _op_kind("while.3") == "while"
+    assert _op_kind("copy.15") == "copy"
+
+
+def test_comm_classified_by_op_kind(tmp_path):
+    """A fusion CONSUMING a collective's result is compute, not comm."""
+    from implicitglobalgrid_tpu.utils.profiling import overlap_stats
+
+    metas = [(1, _meta(1, "%add.7 = f32[8]{0} fusion("
+                          "%collective-permute-done.2, %y)"))]
+    lines = [_line("XLA Ops", 0, [_event(1, 0, 2_000_000)])]
+    _write_run(tmp_path, [_plane("/device:TPU:0", lines, metas)])
+    s = overlap_stats(str(tmp_path))["TPU:0"]
+    assert s["comm_us"] == 0.0 and abs(s["compute_us"] - 2.0) < 1e-9
 
 
 def test_trace_and_annotate(tmp_path):
