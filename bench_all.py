@@ -63,11 +63,15 @@ def main() -> None:
         results.append(bench_util.emit(row))
 
     def timed(run_fn, state, nt, chunk):
-        # warm both chunk programs, then time steady state
-        run_fn(state, min(chunk, nt), chunk)
-        igg.tic()
-        out = run_fn(state, nt, chunk)
-        return igg.toc(sync_on=out)
+        """Two-point steady-state: returns equivalent seconds for ``nt``
+        steps, i.e. nt * the per-step slope (`bench_util.two_point`)."""
+        del chunk
+
+        def one(c):
+            run_fn(state, c, c)  # run_* drain internally (run_chunked)
+
+        c1 = max(1, nt // 10)
+        return nt * bench_util.two_point(one, c1, 3 * c1)
 
     # --- diffusion3D f32 / f64 (BASELINE configs 1, 3) ---------------------
     nx, nt = (48, 50) if cpu else (256, 1000)

@@ -46,13 +46,12 @@ def main() -> None:
     import implicitglobalgrid_tpu as igg
 
     if cpu:
-        nx, chunk, nchunks = 64, 20, 1
+        nx, c1 = 64, 5
         dims = (2, 2, 2)
     else:
-        nx, chunk, nchunks = 512, 200, 5
+        nx, c1 = 512, 60
         nd = len(jax.devices())
         dims = tuple(int(d) for d in igg.dims_create(nd, (0, 0, 0)))
-    reps = chunk * nchunks
 
     igg.init_global_grid(nx, nx, nx, dimx=dims[0], dimy=dims[1], dimz=dims[2],
                          periodx=1, periody=1, periodz=1, quiet=True)
@@ -62,20 +61,17 @@ def main() -> None:
 
     from implicitglobalgrid_tpu.models.common import make_state_runner
 
-    run = make_state_runner(lambda s: (igg.local_update_halo(s[0]),), (3,),
-                            nt_chunk=chunk, key="bench_halo")
+    def chunk(c):
+        run = make_state_runner(lambda s: (igg.local_update_halo(s[0]),),
+                                (3,), nt_chunk=c, key="bench_halo")
+        igg.sync(run(A))
 
-    igg.sync(run(A))  # compile + drain
-
-    igg.tic()
-    for _ in range(nchunks):
-        (A,) = run(A)
-    t = igg.toc(sync_on=A)
+    s = bench_util.two_point(chunk, c1, 3 * c1)
 
     itemsize = 4
     planes = [nx * nx] * 3  # local plane cells per dim (cubic block)
     bytes_per_call = sum(4 * hw[d] * planes[d] * itemsize for d in range(3))
-    gbps = bytes_per_call * reps / t / 1e9
+    gbps = bytes_per_call / s / 1e9
     # No published reference number exists (BASELINE.md: qualitative claim
     # only); vs_baseline is vs 1 GB/s/chip as a nominal floor.
     bench_util.emit({

@@ -160,13 +160,50 @@ def _make_p_get_slab(P, Vx, Vy, Vz, cx, cy, cz, dtK, dx, dy, dz):
     return get
 
 
+def _self_deliver(u, g, nx_planes, fmodes, rx, ol_y, ol_z):
+    """ALL-SELF-NEIGHBOR delivery of one computed plane (halowidth 1).
+
+    The single-shard-periodic analog of `pallas_common.deliver_recvs`,
+    with NO received slabs for y/z: their halo rows/lanes are in-plane
+    copies of the plane's own interior (the reference's
+    `sendrecv_halo_local`, `update_halo.jl:363-380`), and the x halo
+    planes are replaced by ``rx`` — the RAW updated source planes — before
+    the selects, so the z-then-y edits land on them exactly as the
+    sequential z, x, y order produces (x slab extracted post-z ==
+    raw slab with the z select re-applied, because z's sources are the
+    slab's own lanes).
+
+    ``ol_y``/``ol_z`` are the field's overlaps along y/z (source index
+    ``ol-1`` fills the right halo, ``extent-ol`` the left), or None when
+    that dim doesn't exchange for this field."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rows, cols = u.shape
+    if fmodes[0] and rx is not None:
+        u = jnp.where(g == 0, rx[0], jnp.where(g == nx_planes - 1, rx[1], u))
+    if fmodes[2] and ol_z is not None:
+        col = lax.broadcasted_iota(jnp.int32, (rows, cols), 1)
+        u = jnp.where(col == 0, u[:, cols - ol_z:cols - ol_z + 1], u)
+        u = jnp.where(col == cols - 1, u[:, ol_z - 1:ol_z], u)
+    if fmodes[1] and ol_y is not None:
+        row = lax.broadcasted_iota(jnp.int32, (rows, cols), 0)
+        u = jnp.where(row == 0, u[rows - ol_y:rows - ol_y + 1, :], u)
+        u = jnp.where(row == rows - 1, u[ol_y - 1:ol_y, :], u)
+    return u
+
+
 def _wave_plane_body(g, nx, p_m, p_c, p_p, vx_c, vx_p, vy_c, vz_c,
                      rP, rVx, rVy, rVz, *, modes, cx, cy, cz, dtK,
-                     dx, dy, dz):
+                     dx, dy, dz, self_ols=None):
     """The fused-step arithmetic for ONE global x-plane ``g``: velocity
     updates, velocity halo delivery, pressure update from the delivered
     faces, pressure halo delivery. Shared by the plane-per-program and
-    multi-plane-window kernels. Returns (p_new, vx, vy, vz)."""
+    multi-plane-window kernels. Returns (p_new, vx, vy, vz).
+
+    ``self_ols`` (all-self-neighbor grids): ``{field: (ol_y, ol_z)}`` —
+    y/z halos become in-plane selects via `_self_deliver` (the r* dicts
+    then carry only the "x" slabs)."""
     import jax.numpy as jnp
 
     ny, nz = p_c.shape
@@ -178,6 +215,18 @@ def _wave_plane_body(g, nx, p_m, p_c, p_p, vx_c, vx_p, vy_c, vz_c,
     vy = vy_c + cy * jnp.pad(dyv, ((1, 1), (0, 0)))
     dzv = p_c[:, 1:] - p_c[:, :-1]
     vz = vz_c + cz * jnp.pad(dzv, ((0, 0), (1, 1)))
+
+    if self_ols is not None:
+        vx = _self_deliver(vx, g, nx, modes["Vx"], None, *self_ols["Vx"])
+        vy = _self_deliver(vy, g, nx, modes["Vy"], rVy["x"], *self_ols["Vy"])
+        vz = _self_deliver(vz, g, nx, modes["Vz"], rVz["x"], *self_ols["Vz"])
+        divx = (vxp - vx) / dx
+        divy = (vy[1:, :] - vy[:-1, :]) / dy
+        divz = (vz[:, 1:] - vz[:, :-1]) / dz
+        p_new = p_c - dtK * (divx + divy + divz)
+        p_new = _self_deliver(p_new, g, nx, modes["P"], rP["x"],
+                              *self_ols["P"])
+        return p_new, vx, vy, vz
 
     # --- velocity halo delivery (z, x, y; Vx's x planes are post-kernel)
     vx = _deliver(vx, g, nx, modes["Vx"], None, rVx["y"], rVx["z"],
@@ -199,7 +248,18 @@ def _wave_plane_body(g, nx, p_m, p_c, p_p, vx_c, vx_p, vy_c, vz_c,
     return p_new, vx, vy, vz
 
 
-def _wave_kernel(*refs, nx, modes, cx, cy, cz, dtK, dx, dy, dz):
+def _wave_recv_kinds(all_self: bool):
+    """(field, kinds) recv-operand order shared by the kernels and the
+    host wiring: all-self grids pass only the x slabs (y/z become
+    in-plane selects, `_self_deliver`)."""
+    if all_self:
+        return (("P", ("x",)), ("Vx", ()), ("Vy", ("x",)), ("Vz", ("x",)))
+    return (("P", ("x", "y", "z")), ("Vx", ("y", "z")),
+            ("Vy", ("x", "y", "z")), ("Vz", ("x", "y", "z")))
+
+
+def _wave_kernel(*refs, nx, modes, cx, cy, cz, dtK, dx, dy, dz,
+                 self_ols=None):
     """Plane-per-program form of the fused step (`_wave_plane_body`)."""
     from jax.experimental import pallas as pl
 
@@ -210,16 +270,18 @@ def _wave_kernel(*refs, nx, modes, cx, cy, cz, dtK, dx, dy, dz):
     vx_c, vx_p = (next(it)[0] for _ in range(2))
     vy_c = next(it)[0]
     vz_c = next(it)[0]
-    rP = take_recvs(it, modes, "P", ("x", "y", "z"))
-    rVx = take_recvs(it, modes, "Vx", ("y", "z"))
-    rVy = take_recvs(it, modes, "Vy", ("x", "y", "z"))
-    rVz = take_recvs(it, modes, "Vz", ("x", "y", "z"))
+    kinds = dict(_wave_recv_kinds(self_ols is not None))
+    rP = take_recvs(it, modes, "P", kinds["P"])
+    rVx = take_recvs(it, modes, "Vx", kinds["Vx"])
+    rVy = take_recvs(it, modes, "Vy", kinds["Vy"])
+    rVz = take_recvs(it, modes, "Vz", kinds["Vz"])
     oP, oVx, oVy, oVz = refs[-4:]
 
     i = pl.program_id(0)
     p_new, vx, vy, vz = _wave_plane_body(
         i, nx, p_m, p_c, p_p, vx_c, vx_p, vy_c, vz_c, rP, rVx, rVy, rVz,
-        modes=modes, cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dx, dy=dy, dz=dz)
+        modes=modes, cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dx, dy=dy, dz=dz,
+        self_ols=self_ols)
     oP[0] = p_new
     oVx[0] = vx
     oVy[0] = vy
@@ -263,7 +325,8 @@ def wave_mp_planes(p_shape, dtype, interpret=False):
     return None
 
 
-def _wave_mp_kernel(*refs, nx, P, modes, cx, cy, cz, dtK, dx, dy, dz):
+def _wave_mp_kernel(*refs, nx, P, modes, cx, cy, cz, dtK, dx, dy, dz,
+                    self_ols=None):
     """Multi-plane form: P output planes per program; the pressure planes
     come from a double-buffered (P+2)-window and the Vx faces from a
     (P+1)-window (faces g0..g0+P — exact, no clamping), cutting their HBM
@@ -284,8 +347,7 @@ def _wave_mp_kernel(*refs, nx, P, modes, cx, cy, cz, dtK, dx, dy, dz):
     from .pallas_common import AXIS_OF
 
     got = {}
-    for field, kinds in (("P", ("x", "y", "z")), ("Vx", ("y", "z")),
-                         ("Vy", ("x", "y", "z")), ("Vz", ("x", "y", "z"))):
+    for field, kinds in _wave_recv_kinds(self_ols is not None):
         d = {}
         for k in kinds:
             if not modes[field][AXIS_OF[k]]:
@@ -316,14 +378,16 @@ def _wave_mp_kernel(*refs, nx, P, modes, cx, cy, cz, dtK, dx, dy, dz):
         p_p = p_win[pl.ds(jnp.minimum(l + 1, P + 1), 1)][0]
         vx_c = vx_win[pl.ds(j, 1)][0]
         vx_p = vx_win[pl.ds(j + 1, 1)][0]
-        rPj = {k: per_plane("P", k, j) for k in ("x", "y", "z")}
-        rVxj = {k: per_plane("Vx", k, j) for k in ("y", "z")}
-        rVyj = {k: per_plane("Vy", k, j) for k in ("x", "y", "z")}
-        rVzj = {k: per_plane("Vz", k, j) for k in ("x", "y", "z")}
+        kinds = dict(_wave_recv_kinds(self_ols is not None))
+        rPj = {k: per_plane("P", k, j) for k in kinds["P"]}
+        rVxj = {k: per_plane("Vx", k, j) for k in kinds["Vx"]}
+        rVyj = {k: per_plane("Vy", k, j) for k in kinds["Vy"]}
+        rVzj = {k: per_plane("Vz", k, j) for k in kinds["Vz"]}
         p_new, vx, vy, vz = _wave_plane_body(
             g, nx, p_m, p_c, p_p, vx_c, vx_p, vy_blk[j], vz_blk[j],
             rPj, rVxj, rVyj, rVzj,
-            modes=modes, cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dx, dy=dy, dz=dz)
+            modes=modes, cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dx, dy=dy, dz=dz,
+            self_ols=self_ols)
         oP[j] = p_new
         oVx[j] = vx
         oVy[j] = vy
@@ -349,16 +413,44 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
     dxp, dyp, dzp = (dtp(v) for v in (dx, dy, dz))
     hws = (1, 1, 1)
 
+    # ALL-SELF fast path (single-shard periodic on every exchanging dim —
+    # the reference's sendrecv_halo_local situation): y/z halos become
+    # in-plane selects INSIDE the kernel and the x slabs are the raw
+    # updated source planes (`_self_deliver` re-applies the z/y edits), so
+    # the whole slab pipeline (per-dim mini-computes, corner patching,
+    # local swaps — measured at ~2/3 of the step on v5e) collapses to at
+    # most four 2-plane computes.
+    exch_dims = [d for d in range(3) if any(m[d] for m in modes.values())]
+    all_self = all(int(gg.dims[d]) == 1 and bool(gg.periods[d])
+                   for d in exch_dims) and bool(exch_dims)
+    getters = {
+        "Vx": _make_v_get_slab(Vx, P, 0, cx),
+        "Vy": _make_v_get_slab(Vy, P, 1, cy),
+        "Vz": _make_v_get_slab(Vz, P, 2, cz),
+        "P": _make_p_get_slab(P, Vx, Vy, Vz, cx, cy, cz, dtK, dxp, dyp, dzp),
+    }
+    shapes = {"P": P.shape, "Vx": Vx.shape, "Vy": Vy.shape, "Vz": Vz.shape}
     recvs = {}
-    recvs["Vx"] = exchange_recv_slabs(gg, Vx.shape, hws, modes["Vx"],
-                                      _make_v_get_slab(Vx, P, 0, cx))
-    recvs["Vy"] = exchange_recv_slabs(gg, Vy.shape, hws, modes["Vy"],
-                                      _make_v_get_slab(Vy, P, 1, cy))
-    recvs["Vz"] = exchange_recv_slabs(gg, Vz.shape, hws, modes["Vz"],
-                                      _make_v_get_slab(Vz, P, 2, cz))
-    recvs["P"] = exchange_recv_slabs(
-        gg, P.shape, hws, modes["P"],
-        _make_p_get_slab(P, Vx, Vy, Vz, cx, cy, cz, dtK, dxp, dyp, dzp))
+    self_ols = None
+    if all_self:
+        self_ols = {}
+        for f, shape in shapes.items():
+            ol = [int(gg.overlaps[d]) + (int(shape[d]) - int(gg.nxyz[d]))
+                  for d in range(3)]
+            self_ols[f] = (ol[1] if modes[f][1] else None,
+                           ol[2] if modes[f][2] else None)
+            if modes[f][0]:
+                s0 = int(shape[0])
+                # recv_l <- own right send slab (raw updated plane), and
+                # vice versa (sendrecv_halo_local, update_halo.jl:363-380)
+                recvs[f] = {0: (getters[f](0, s0 - ol[0], 1),
+                                getters[f](0, ol[0] - 1, 1))}
+            else:
+                recvs[f] = {}
+    else:
+        for f in ("Vx", "Vy", "Vz", "P"):
+            recvs[f] = exchange_recv_slabs(gg, shapes[f], hws, modes[f],
+                                           getters[f])
 
     def spec(shape, index_map):
         return pl.BlockSpec(shape, index_map)
@@ -395,16 +487,21 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
 
     c0 = lambda i: (0, 0, 0)
     ci = lambda i: (i, 0, 0)
-    add_recvs("P", ("x", "y", "z"), [
-        (0, (2, ny, nz), c0), (1, (B, 2, nz), ci), (2, (B, ny, 2), ci)])
-    add_recvs("Vx", ("y", "z"), [
-        (1, (B, 2, nz), ci), (2, (B, ny, 2), ci)])
-    add_recvs("Vy", ("x", "y", "z"), [
-        (0, (2, ny + 1, nz), c0), (1, (B, 2, nz), ci),
-        (2, (B, ny + 1, 2), ci)])
-    add_recvs("Vz", ("x", "y", "z"), [
-        (0, (2, ny, nz + 1), c0), (1, (B, 2, nz + 1), ci),
-        (2, (B, ny, 2), ci)])
+    all_specs = {
+        "P": [(0, (2, ny, nz), c0), (1, (B, 2, nz), ci),
+              (2, (B, ny, 2), ci)],
+        "Vx": [(1, (B, 2, nz), ci), (2, (B, ny, 2), ci)],
+        "Vy": [(0, (2, ny + 1, nz), c0), (1, (B, 2, nz), ci),
+               (2, (B, ny + 1, 2), ci)],
+        "Vz": [(0, (2, ny, nz + 1), c0), (1, (B, 2, nz + 1), ci),
+               (2, (B, ny, 2), ci)],
+    }
+    from .pallas_common import AXIS_OF
+
+    for field, kinds in _wave_recv_kinds(all_self):
+        rows = [ss for k in kinds for ss in all_specs[field]
+                if ss[0] == AXIS_OF[k]]
+        add_recvs(field, kinds, rows)
 
     def out_shape_of(a):
         return out_shape_with_vma(a, operands)
@@ -425,7 +522,7 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
 
         kernel = partial(_wave_mp_kernel, nx=nx, P=Pmp, modes=kmod,
                          cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dxp, dy=dyp,
-                         dz=dzp)
+                         dz=dzp, self_ols=self_ols)
         Pn, Vxn, Vyn, Vzn = pl.pallas_call(
             kernel,
             grid=(nx // Pmp,),
@@ -442,7 +539,8 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
     else:
         kernel = partial(
             _wave_kernel, nx=nx, modes=kmod,
-            cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dxp, dy=dyp, dz=dzp)
+            cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dxp, dy=dyp, dz=dzp,
+            self_ols=self_ols)
         Pn, Vxn, Vyn, Vzn = pl.pallas_call(
             kernel,
             grid=(nx,),
@@ -458,8 +556,40 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
     from .pallas_common import vx_extra_plane_slabs
     from .pallas_halo import halo_write_inplace
 
-    plane0, planeN = vx_extra_plane_slabs(Vx, Vxn, recvs["Vx"],
-                                          modes["Vx"], nx)
+    if all_self:
+        plane0, planeN = _vx_extra_planes_self(
+            Vx, Vxn, recvs["Vx"], modes["Vx"], self_ols["Vx"], nx)
+    else:
+        plane0, planeN = vx_extra_plane_slabs(Vx, Vxn, recvs["Vx"],
+                                              modes["Vx"], nx)
     Vxn = halo_write_inplace(Vxn, plane0, planeN, dim=0, hw=1,
                              interpret=interpret)
     return (Pn, Vxn, Vyn, Vzn)
+
+
+def _vx_extra_planes_self(Vx, Vxn, recvs_vx, modes_vx, ols_vx, nx):
+    """Final Vx planes 0 and nx on an ALL-SELF grid: both x halo planes
+    come from the raw updated source slabs (plane 0 <- updated plane
+    nx-2, plane nx <- updated plane 2; `sendrecv_halo_local` routing)
+    with the z-then-y in-plane selects applied — the same order/argument
+    as `_self_deliver`. When x doesn't exchange, plane 0 is already final
+    in the kernel output and plane nx keeps its raw values + selects."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    ol_y, ol_z = ols_vx
+
+    def selects(plane):
+        # the same z-then-y in-plane routing as the kernel's deliveries
+        # (x disabled: these ARE the x planes)
+        return _self_deliver(plane[0], 0, 1, (False, modes_vx[1],
+                                              modes_vx[2]), None,
+                             ol_y, ol_z)[None]
+
+    if modes_vx[0]:
+        plane0 = selects(recvs_vx[0][0])            # raw updated plane nx-2
+        planeN = selects(recvs_vx[0][1])            # raw updated plane 2
+    else:
+        plane0 = lax.slice_in_dim(Vxn, 0, 1, axis=0)
+        planeN = selects(lax.slice_in_dim(Vx, nx, nx + 1, axis=0))
+    return plane0, planeN

@@ -13,32 +13,104 @@ from __future__ import annotations
 
 import time
 
-from ..parallel.topology import AXIS_NAMES, check_initialized, global_grid
+from ..parallel.topology import (
+    AXIS_NAMES, check_initialized, global_grid, grid_is_initialized,
+)
 
 __all__ = ["tic", "toc", "barrier", "sync", "init_timing_functions"]
 
 _t0 = None
 _probe_cache: dict = {}
+_drain_cache: dict = {}
+
+
+def _drain_fn(gg, sig):
+    """Compiled drain for a leaf signature: local first element of every
+    leaf (inside shard_map, so each SHARD contributes), psum over every
+    mesh axis, ONE replicated scalar out. Fetching that scalar proves every
+    device executed past all the leaves' producers — one D2H round trip
+    total instead of one per shard per array (a large fixed cost on
+    tunneled PJRT transports)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    specs = tuple(spec for (_, _, spec) in sig)
+
+    def drain(*leaves):
+        s = jnp.zeros((), jnp.float32)
+        for x in leaves:
+            v = x[(0,) * x.ndim] if x.ndim else x
+            if jnp.issubdtype(v.dtype, jnp.complexfloating):
+                v = v.real
+            s = s + v.astype(jnp.float32)
+        for ax in AXIS_NAMES:
+            s = lax.psum(s, ax)
+        return s
+
+    return jax.jit(jax.shard_map(drain, mesh=gg.mesh, in_specs=specs,
+                                 out_specs=P()))
+
+
+def _sync_strong(tree):
+    """Drain ``tree`` with the single-fetch compiled program when every
+    array leaf is NamedSharding'ed on the grid mesh; returns (tree, True)
+    on success, (tree, False) when some leaf needs the per-shard path."""
+    import jax
+    import numpy as np
+
+    if not grid_is_initialized():
+        return tree, False
+    gg = global_grid()
+    if gg.mesh is None:
+        return tree, False
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if isinstance(l, jax.Array)]
+    if not leaves:
+        # nothing to drain, but NOT a barrier either — callers relying on
+        # the barrier semantics (tic/toc/barrier) must still run the probe
+        return tree, False
+    sig = []
+    for l in leaves:
+        sh = l.sharding
+        if not (isinstance(sh, jax.sharding.NamedSharding)
+                and sh.mesh == gg.mesh):
+            return tree, False
+        sig.append((tuple(l.shape), str(l.dtype), sh.spec))
+    key = (gg.epoch, tuple(sig))
+    fn = _drain_cache.get(key)
+    if fn is None:
+        if _drain_cache and next(iter(_drain_cache))[0] != gg.epoch:
+            _drain_cache.clear()
+        fn = _drain_fn(gg, sig)
+        _drain_cache[key] = fn
+    np.asarray(fn(*leaves))  # concrete fetch = the ordering guarantee
+    return tree, True
 
 
 def sync(tree):
     """Force completion of every computation producing ``tree``'s arrays and
     return ``tree``.
 
-    Stronger than ``jax.block_until_ready``: fetches ONE element of every
-    device shard, which cannot resolve before that device's producing program
-    finishes. Needed because some PJRT transports (e.g. the axon TPU tunnel)
-    let ``block_until_ready`` — and even independent barrier programs —
-    return before queued work completes; a concrete value fetch is the only
-    ordering guarantee that holds everywhere. Cost: one scalar D2H per shard.
+    Stronger than ``jax.block_until_ready``: resolves a CONCRETE value that
+    data-depends on every shard of every leaf. Needed because some PJRT
+    transports (e.g. the axon TPU tunnel) let ``block_until_ready`` — and
+    even independent barrier programs — return before queued work
+    completes; a concrete value fetch is the only ordering guarantee that
+    holds everywhere.
 
-    Works for multi-host arrays too: the global array cannot be eagerly
-    indexed when not fully addressable, but each ``shard.data`` is a local
-    single-device array and fetching from it is always legal.
+    Fast path (grid-mesh arrays): ONE compiled psum-drain program and ONE
+    scalar D2H for the whole tree (cached per tree signature). Fallback
+    (foreign shardings, no grid): one element per device shard —
+    ``shard.data`` is locally addressable even for multi-host arrays.
     """
     import jax
     import numpy as np
 
+    tree, done = _sync_strong(tree)
+    if done:
+        return tree
     for leaf in jax.tree_util.tree_leaves(tree):
         if isinstance(leaf, jax.Array):
             for shard in leaf.addressable_shards:
@@ -69,11 +141,37 @@ def _device_barrier() -> None:
 
         fn = jax.jit(jax.shard_map(probe, mesh=mesh, in_specs=P(), out_specs=P()))
         _probe_cache[key] = fn
-    jax.block_until_ready(fn(jnp.zeros(())))
+    # concrete fetch, not block_until_ready — the latter can return early
+    # on some PJRT transports (see `sync`)
+    import numpy as np
+
+    np.asarray(fn(jnp.zeros(())))
     if jax.process_count() > 1:  # DCN barrier for multi-host
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("igg_tpu_barrier")
+
+
+def _sync_then_barrier(sync_on) -> None:
+    """Shared tic/toc/barrier path. When ``sync_on`` drains through the
+    strong single-fetch program, that drain already psums over every mesh
+    axis and resolves concretely — strictly stronger than the probe — so
+    the separate device barrier (an extra D2H round trip inside timed
+    windows) is skipped; multi-host still adds the DCN sync."""
+    import jax
+
+    strong = False
+    if sync_on is not None:
+        _, strong = _sync_strong(sync_on)
+        if not strong:
+            sync(sync_on)
+    if strong:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("igg_tpu_barrier")
+        return
+    _device_barrier()
 
 
 def barrier(sync_on=None) -> None:
@@ -81,9 +179,7 @@ def barrier(sync_on=None) -> None:
     arrays whose pending computations must drain as ``sync_on`` for a
     data-dependent guarantee (see `sync`)."""
     check_initialized()
-    if sync_on is not None:
-        sync(sync_on)
-    _device_barrier()
+    _sync_then_barrier(sync_on)
 
 
 def tic(sync_on=None) -> None:
@@ -91,9 +187,7 @@ def tic(sync_on=None) -> None:
     (reference `tools.jl:234`)."""
     global _t0
     check_initialized()
-    if sync_on is not None:
-        sync(sync_on)
-    _device_barrier()
+    _sync_then_barrier(sync_on)
     _t0 = time.time()
 
 
@@ -103,9 +197,7 @@ def toc(sync_on=None) -> float:
     as ``sync_on`` to guarantee their computations are included (data-
     dependent drain; framework runners like ``run_chunked`` already sync)."""
     check_initialized()
-    if sync_on is not None:
-        sync(sync_on)
-    _device_barrier()
+    _sync_then_barrier(sync_on)
     return time.time() - _t0
 
 
