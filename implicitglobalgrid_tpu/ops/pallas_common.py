@@ -8,7 +8,9 @@ from __future__ import annotations
 
 __all__ = ["slab1", "take_recvs", "add_recv_operands", "out_shape_with_vma",
            "vx_extra_plane_slabs", "deliver_recvs", "AXIS_OF",
-           "shift_up", "shift_down", "shift_left", "shift_right"]
+           "shift_up", "shift_down", "shift_left", "shift_right",
+           "self_deliver", "all_self_exchange", "self_recvs_and_ols",
+           "vx_extra_planes_self", "recv_kinds", "add_all_recvs"]
 
 AXIS_OF = {"x": 0, "y": 1, "z": 2}
 
@@ -139,6 +141,117 @@ def vx_extra_plane_slabs(Vx, Vxn, recvs_vx, modes_vx, nx):
     planeN = row_patch(lane_patch(
         lax.slice_in_dim(Vx, nx, nx + 1, axis=0), nx), nx)
     plane0 = lax.slice_in_dim(Vxn, 0, 1, axis=0)
+    return plane0, planeN
+
+
+def self_deliver(u, g, nx_planes, fmodes, rx, ol_y, ol_z):
+    """ALL-SELF-NEIGHBOR delivery of one computed plane (halowidth 1).
+
+    The single-shard-periodic analog of `deliver_recvs`, with NO received
+    slabs for y/z: their halo rows/lanes are in-plane copies of the
+    plane's own interior (the reference's `sendrecv_halo_local`,
+    `update_halo.jl:363-380`), and the x halo planes are replaced by
+    ``rx`` — the RAW updated source planes — BEFORE the selects, so the
+    z-then-y edits land on them exactly as the sequential z, x, y order
+    produces (an x slab extracted post-z == the raw slab with the z
+    select re-applied, because z's sources are the slab's own lanes).
+
+    ``ol_y``/``ol_z`` are the field's overlaps along y/z (source index
+    ``ol-1`` fills the right halo, ``extent-ol`` the left), or None when
+    that dim doesn't exchange for this field."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rows, cols = u.shape
+    if fmodes[0] and rx is not None:
+        u = jnp.where(g == 0, rx[0], jnp.where(g == nx_planes - 1, rx[1], u))
+    if fmodes[2] and ol_z is not None:
+        col = lax.broadcasted_iota(jnp.int32, (rows, cols), 1)
+        u = jnp.where(col == 0, u[:, cols - ol_z:cols - ol_z + 1], u)
+        u = jnp.where(col == cols - 1, u[:, ol_z - 1:ol_z], u)
+    if fmodes[1] and ol_y is not None:
+        row = lax.broadcasted_iota(jnp.int32, (rows, cols), 0)
+        u = jnp.where(row == 0, u[rows - ol_y:rows - ol_y + 1, :], u)
+        u = jnp.where(row == rows - 1, u[ol_y - 1:ol_y, :], u)
+    return u
+
+
+def all_self_exchange(gg, modes) -> bool:
+    """Whether every exchanging dim of a multi-field kernel takes the
+    self-neighbor path (single shard, periodic) — the gate for the
+    in-kernel `self_deliver` fast path."""
+    exch = [d for d in range(3) if any(m[d] for m in modes.values())]
+    return bool(exch) and all(
+        int(gg.dims[d]) == 1 and bool(gg.periods[d]) for d in exch)
+
+
+def self_recvs_and_ols(gg, shapes, modes, getters):
+    """Host-side wiring of the all-self fast path: per field, the raw
+    updated x source slabs (recv_l <- own right send slab and vice versa
+    — `sendrecv_halo_local` routing) and the (ol_y, ol_z) select overlaps
+    for `self_deliver`. Returns (recvs, self_ols)."""
+    recvs = {}
+    self_ols = {}
+    for f, shape in shapes.items():
+        ol = [int(gg.overlaps[d]) + (int(shape[d]) - int(gg.nxyz[d]))
+              for d in range(3)]
+        self_ols[f] = (ol[1] if modes[f][1] else None,
+                       ol[2] if modes[f][2] else None)
+        if modes[f][0]:
+            s0 = int(shape[0])
+            recvs[f] = {0: (getters[f](0, s0 - ol[0], 1),
+                            getters[f](0, ol[0] - 1, 1))}
+        else:
+            recvs[f] = {}
+    return recvs, self_ols
+
+
+def recv_kinds(all_self: bool):
+    """(field, kinds) recv-operand order — the kernel<->host protocol of
+    every 4-field fused pass (`pallas_wave`, `pallas_stokes`); both the
+    kernel-side `take_recvs` unpacking and the host-side
+    `add_recv_operands` wiring iterate THIS tuple. All-self grids pass
+    only the x slabs (y/z become in-plane selects, `self_deliver`)."""
+    if all_self:
+        return (("P", ("x",)), ("Vx", ()), ("Vy", ("x",)), ("Vz", ("x",)))
+    return (("P", ("x", "y", "z")), ("Vx", ("y", "z")),
+            ("Vy", ("x", "y", "z")), ("Vz", ("x", "y", "z")))
+
+
+def add_all_recvs(operands, in_specs, modes, recvs, all_specs, all_self):
+    """Host-side recv wiring for the 4-field fused passes: append every
+    participating field/kind's slabs in `recv_kinds` order, with the
+    BlockSpec rows of ``all_specs[field]`` matched by concat axis."""
+    for field, kinds in recv_kinds(all_self):
+        rows = [ss for k in kinds for ss in all_specs[field]
+                if ss[0] == AXIS_OF[k]]
+        add_recv_operands(operands, in_specs, modes, recvs, field, kinds,
+                          rows)
+
+
+def vx_extra_planes_self(Vx, Vxn, recvs_vx, modes_vx, ols_vx, nx):
+    """Final values of an x-staggered field's planes 0 and nx on an
+    ALL-SELF grid: both x halo planes come from the raw updated source
+    slabs (plane 0 <- updated plane nx-ol, plane nx <- updated plane
+    ol-1) with the z-then-y in-plane selects applied — the same
+    order/argument as `self_deliver`. When x doesn't exchange, plane 0 is
+    already final in the kernel output and plane nx keeps its raw values
+    + selects."""
+    from jax import lax
+
+    ol_y, ol_z = ols_vx
+
+    def selects(plane):
+        return self_deliver(plane[0], 0, 1,
+                            (False, modes_vx[1], modes_vx[2]), None,
+                            ol_y, ol_z)[None]
+
+    if modes_vx[0]:
+        plane0 = selects(recvs_vx[0][0])
+        planeN = selects(recvs_vx[0][1])
+    else:
+        plane0 = lax.slice_in_dim(Vxn, 0, 1, axis=0)
+        planeN = selects(lax.slice_in_dim(Vx, nx, nx + 1, axis=0))
     return plane0, planeN
 
 

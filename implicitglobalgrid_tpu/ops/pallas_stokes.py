@@ -124,7 +124,11 @@ def _v_get_slab(state, p, which):
     return get
 
 
-def _stokes_kernel(*refs, nx, modes, mu, dt_v, dt_p, damp, dx, dy, dz):
+from .pallas_common import recv_kinds as _stokes_recv_kinds
+
+
+def _stokes_kernel(*refs, nx, modes, mu, dt_v, dt_p, damp, dx, dy, dz,
+                   self_ols=None):
     """One x-plane of the fused PT iteration. Arithmetic mirrors
     `models.stokes._stokes_terms` term-for-term (same accumulation order)
     restricted to this plane; then the interior-masked dV/V updates and the
@@ -157,10 +161,11 @@ def _stokes_kernel(*refs, nx, modes, mu, dt_v, dt_p, damp, dx, dy, dz):
 
     from .pallas_common import take_recvs
 
-    rP = take_recvs(it, modes, "P", ("x", "y", "z"))
-    rVx = take_recvs(it, modes, "Vx", ("y", "z"))
-    rVy = take_recvs(it, modes, "Vy", ("x", "y", "z"))
-    rVz = take_recvs(it, modes, "Vz", ("x", "y", "z"))
+    kinds = dict(_stokes_recv_kinds(self_ols is not None))
+    rP = take_recvs(it, modes, "P", kinds["P"])
+    rVx = take_recvs(it, modes, "Vx", kinds["Vx"])
+    rVy = take_recvs(it, modes, "Vy", kinds["Vy"])
+    rVz = take_recvs(it, modes, "Vz", kinds["Vz"])
     oP, oVx, oVy, oVz, odVx, odVy, odVz = refs[-7:]
 
     i = pl.program_id(0)
@@ -230,14 +235,25 @@ def _stokes_kernel(*refs, nx, modes, mu, dt_v, dt_p, damp, dx, dy, dz):
     u_vz = jnp.where(mz, vzc + dt_v * dnz, vzc)
 
     # --- halo deliveries (z, x, y per field) ------------------------------
-    u_vx = _deliver(u_vx, i, nx, modes["Vx"], None, rVx["y"], rVx["z"],
-                    ny - 1, nz - 1)
-    u_vy = _deliver(u_vy, i, nx, modes["Vy"], rVy["x"], rVy["y"], rVy["z"],
-                    ny, nz - 1)
-    u_vz = _deliver(u_vz, i, nx, modes["Vz"], rVz["x"], rVz["y"], rVz["z"],
-                    ny - 1, nz)
-    pn = _deliver(pnc, i, nx, modes["P"], rP["x"], rP["y"], rP["z"],
-                  ny - 1, nz - 1)
+    if self_ols is not None:
+        from .pallas_common import self_deliver
+
+        u_vx = self_deliver(u_vx, i, nx, modes["Vx"], None,
+                            *self_ols["Vx"])
+        u_vy = self_deliver(u_vy, i, nx, modes["Vy"], rVy["x"],
+                            *self_ols["Vy"])
+        u_vz = self_deliver(u_vz, i, nx, modes["Vz"], rVz["x"],
+                            *self_ols["Vz"])
+        pn = self_deliver(pnc, i, nx, modes["P"], rP["x"], *self_ols["P"])
+    else:
+        u_vx = _deliver(u_vx, i, nx, modes["Vx"], None, rVx["y"], rVx["z"],
+                        ny - 1, nz - 1)
+        u_vy = _deliver(u_vy, i, nx, modes["Vy"], rVy["x"], rVy["y"],
+                        rVy["z"], ny, nz - 1)
+        u_vz = _deliver(u_vz, i, nx, modes["Vz"], rVz["x"], rVz["y"],
+                        rVz["z"], ny - 1, nz)
+        pn = _deliver(pnc, i, nx, modes["P"], rP["x"], rP["y"], rP["z"],
+                      ny - 1, nz - 1)
 
     oP[0] = pn
     oVx[0] = u_vx
@@ -263,16 +279,26 @@ def stokes_step_exchange_pallas(state, gg, modes, p, *, interpret=False):
     dtp = P.dtype.type
     hws = (1, 1, 1)
 
-    recvs = {
-        "Vx": exchange_recv_slabs(gg, Vx.shape, hws, modes["Vx"],
-                                  _v_get_slab(state, p, 0)),
-        "Vy": exchange_recv_slabs(gg, Vy.shape, hws, modes["Vy"],
-                                  _v_get_slab(state, p, 1)),
-        "Vz": exchange_recv_slabs(gg, Vz.shape, hws, modes["Vz"],
-                                  _v_get_slab(state, p, 2)),
-        "P": exchange_recv_slabs(gg, P.shape, hws, modes["P"],
-                                 _pn_get_slab(state, p)),
+    from .pallas_common import all_self_exchange, self_recvs_and_ols
+
+    getters = {
+        "Vx": _v_get_slab(state, p, 0),
+        "Vy": _v_get_slab(state, p, 1),
+        "Vz": _v_get_slab(state, p, 2),
+        "P": _pn_get_slab(state, p),
     }
+    shapes = {"P": P.shape, "Vx": Vx.shape, "Vy": Vy.shape, "Vz": Vz.shape}
+    all_self = all_self_exchange(gg, modes)
+    self_ols = None
+    if all_self:
+        # single-shard periodic on every exchanging dim: y/z halos become
+        # in-plane selects inside the kernel, x slabs are raw updated
+        # source planes (see pallas_wave / pallas_common.self_deliver)
+        recvs, self_ols = self_recvs_and_ols(gg, shapes, modes, getters)
+    else:
+        recvs = {f: exchange_recv_slabs(gg, shapes[f], hws, modes[f],
+                                        getters[f])
+                 for f in ("Vx", "Vy", "Vz", "P")}
 
     def spec(shape, index_map):
         return pl.BlockSpec(shape, index_map)
@@ -308,16 +334,18 @@ def stokes_step_exchange_pallas(state, gg, modes, p, *, interpret=False):
 
     c0 = lambda i: (0, 0, 0)
     ci = lambda i: (i, 0, 0)
-    add_recvs("P", ("x", "y", "z"), [
-        (0, (2, ny, nz), c0), (1, (1, 2, nz), ci), (2, (1, ny, 2), ci)])
-    add_recvs("Vx", ("y", "z"), [
-        (1, (1, 2, nz), ci), (2, (1, ny, 2), ci)])
-    add_recvs("Vy", ("x", "y", "z"), [
-        (0, (2, ny + 1, nz), c0), (1, (1, 2, nz), ci),
-        (2, (1, ny + 1, 2), ci)])
-    add_recvs("Vz", ("x", "y", "z"), [
-        (0, (2, ny, nz + 1), c0), (1, (1, 2, nz + 1), ci),
-        (2, (1, ny, 2), ci)])
+    all_specs = {
+        "P": [(0, (2, ny, nz), c0), (1, (1, 2, nz), ci),
+              (2, (1, ny, 2), ci)],
+        "Vx": [(1, (1, 2, nz), ci), (2, (1, ny, 2), ci)],
+        "Vy": [(0, (2, ny + 1, nz), c0), (1, (1, 2, nz), ci),
+               (2, (1, ny + 1, 2), ci)],
+        "Vz": [(0, (2, ny, nz + 1), c0), (1, (1, 2, nz + 1), ci),
+               (2, (1, ny, 2), ci)],
+    }
+    from .pallas_common import add_all_recvs
+
+    add_all_recvs(operands, in_specs, modes, recvs, all_specs, all_self)
 
     def out_shape_of(a):
         return out_shape_with_vma(a, operands)
@@ -326,7 +354,7 @@ def stokes_step_exchange_pallas(state, gg, modes, p, *, interpret=False):
         _stokes_kernel, nx=nx,
         modes={k: tuple(bool(b) for b in v) for k, v in modes.items()},
         mu=dtp(p.mu), dt_v=dtp(p.dt_v), dt_p=dtp(p.dt_p), damp=dtp(p.damp),
-        dx=dtp(p.dx), dy=dtp(p.dy), dz=dtp(p.dz))
+        dx=dtp(p.dx), dy=dtp(p.dy), dz=dtp(p.dz), self_ols=self_ols)
 
     Pn, Vxn, Vyn, Vzn, dVxn, dVyn, dVzn = pl.pallas_call(
         kernel,
@@ -350,11 +378,15 @@ def stokes_step_exchange_pallas(state, gg, modes, p, *, interpret=False):
     # Vx plane nx (the kernel grid covers planes 0..nx-1): delivered like
     # the acoustic kernel's; dVx plane nx is never updated nor exchanged —
     # rewritten with its raw values.
-    from .pallas_common import vx_extra_plane_slabs
+    from .pallas_common import vx_extra_plane_slabs, vx_extra_planes_self
     from .pallas_halo import halo_write_inplace
 
-    plane0, planeN = vx_extra_plane_slabs(Vx, Vxn, recvs["Vx"],
-                                          modes["Vx"], nx)
+    if all_self:
+        plane0, planeN = vx_extra_planes_self(
+            Vx, Vxn, recvs["Vx"], modes["Vx"], self_ols["Vx"], nx)
+    else:
+        plane0, planeN = vx_extra_plane_slabs(Vx, Vxn, recvs["Vx"],
+                                              modes["Vx"], nx)
     Vxn = halo_write_inplace(Vxn, plane0, planeN, dim=0, hw=1,
                              interpret=interpret)
     dVxn = halo_write_inplace(

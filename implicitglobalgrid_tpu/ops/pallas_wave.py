@@ -160,37 +160,7 @@ def _make_p_get_slab(P, Vx, Vy, Vz, cx, cy, cz, dtK, dx, dy, dz):
     return get
 
 
-def _self_deliver(u, g, nx_planes, fmodes, rx, ol_y, ol_z):
-    """ALL-SELF-NEIGHBOR delivery of one computed plane (halowidth 1).
-
-    The single-shard-periodic analog of `pallas_common.deliver_recvs`,
-    with NO received slabs for y/z: their halo rows/lanes are in-plane
-    copies of the plane's own interior (the reference's
-    `sendrecv_halo_local`, `update_halo.jl:363-380`), and the x halo
-    planes are replaced by ``rx`` — the RAW updated source planes — before
-    the selects, so the z-then-y edits land on them exactly as the
-    sequential z, x, y order produces (x slab extracted post-z ==
-    raw slab with the z select re-applied, because z's sources are the
-    slab's own lanes).
-
-    ``ol_y``/``ol_z`` are the field's overlaps along y/z (source index
-    ``ol-1`` fills the right halo, ``extent-ol`` the left), or None when
-    that dim doesn't exchange for this field."""
-    import jax.numpy as jnp
-    from jax import lax
-
-    rows, cols = u.shape
-    if fmodes[0] and rx is not None:
-        u = jnp.where(g == 0, rx[0], jnp.where(g == nx_planes - 1, rx[1], u))
-    if fmodes[2] and ol_z is not None:
-        col = lax.broadcasted_iota(jnp.int32, (rows, cols), 1)
-        u = jnp.where(col == 0, u[:, cols - ol_z:cols - ol_z + 1], u)
-        u = jnp.where(col == cols - 1, u[:, ol_z - 1:ol_z], u)
-    if fmodes[1] and ol_y is not None:
-        row = lax.broadcasted_iota(jnp.int32, (rows, cols), 0)
-        u = jnp.where(row == 0, u[rows - ol_y:rows - ol_y + 1, :], u)
-        u = jnp.where(row == rows - 1, u[ol_y - 1:ol_y, :], u)
-    return u
+from .pallas_common import self_deliver as _self_deliver
 
 
 def _wave_plane_body(g, nx, p_m, p_c, p_p, vx_c, vx_p, vy_c, vz_c,
@@ -248,14 +218,7 @@ def _wave_plane_body(g, nx, p_m, p_c, p_p, vx_c, vx_p, vy_c, vz_c,
     return p_new, vx, vy, vz
 
 
-def _wave_recv_kinds(all_self: bool):
-    """(field, kinds) recv-operand order shared by the kernels and the
-    host wiring: all-self grids pass only the x slabs (y/z become
-    in-plane selects, `_self_deliver`)."""
-    if all_self:
-        return (("P", ("x",)), ("Vx", ()), ("Vy", ("x",)), ("Vz", ("x",)))
-    return (("P", ("x", "y", "z")), ("Vx", ("y", "z")),
-            ("Vy", ("x", "y", "z")), ("Vz", ("x", "y", "z")))
+from .pallas_common import recv_kinds as _wave_recv_kinds
 
 
 def _wave_kernel(*refs, nx, modes, cx, cy, cz, dtK, dx, dy, dz,
@@ -370,6 +333,7 @@ def _wave_mp_kernel(*refs, nx, P, modes, cx, cy, cz, dtK, dx, dy, dz,
             return None
         return r if k == "x" else r[j]
 
+    kinds = dict(_wave_recv_kinds(self_ols is not None))
     for j in range(P):
         g = g0 + j
         l = l0 + j
@@ -378,7 +342,6 @@ def _wave_mp_kernel(*refs, nx, P, modes, cx, cy, cz, dtK, dx, dy, dz,
         p_p = p_win[pl.ds(jnp.minimum(l + 1, P + 1), 1)][0]
         vx_c = vx_win[pl.ds(j, 1)][0]
         vx_p = vx_win[pl.ds(j + 1, 1)][0]
-        kinds = dict(_wave_recv_kinds(self_ols is not None))
         rPj = {k: per_plane("P", k, j) for k in kinds["P"]}
         rVxj = {k: per_plane("Vx", k, j) for k in kinds["Vx"]}
         rVyj = {k: per_plane("Vy", k, j) for k in kinds["Vy"]}
@@ -420,9 +383,8 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
     # the whole slab pipeline (per-dim mini-computes, corner patching,
     # local swaps — measured at ~2/3 of the step on v5e) collapses to at
     # most four 2-plane computes.
-    exch_dims = [d for d in range(3) if any(m[d] for m in modes.values())]
-    all_self = all(int(gg.dims[d]) == 1 and bool(gg.periods[d])
-                   for d in exch_dims) and bool(exch_dims)
+    from .pallas_common import all_self_exchange, self_recvs_and_ols
+
     getters = {
         "Vx": _make_v_get_slab(Vx, P, 0, cx),
         "Vy": _make_v_get_slab(Vy, P, 1, cy),
@@ -430,27 +392,14 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
         "P": _make_p_get_slab(P, Vx, Vy, Vz, cx, cy, cz, dtK, dxp, dyp, dzp),
     }
     shapes = {"P": P.shape, "Vx": Vx.shape, "Vy": Vy.shape, "Vz": Vz.shape}
-    recvs = {}
+    all_self = all_self_exchange(gg, modes)
     self_ols = None
     if all_self:
-        self_ols = {}
-        for f, shape in shapes.items():
-            ol = [int(gg.overlaps[d]) + (int(shape[d]) - int(gg.nxyz[d]))
-                  for d in range(3)]
-            self_ols[f] = (ol[1] if modes[f][1] else None,
-                           ol[2] if modes[f][2] else None)
-            if modes[f][0]:
-                s0 = int(shape[0])
-                # recv_l <- own right send slab (raw updated plane), and
-                # vice versa (sendrecv_halo_local, update_halo.jl:363-380)
-                recvs[f] = {0: (getters[f](0, s0 - ol[0], 1),
-                                getters[f](0, ol[0] - 1, 1))}
-            else:
-                recvs[f] = {}
+        recvs, self_ols = self_recvs_and_ols(gg, shapes, modes, getters)
     else:
-        for f in ("Vx", "Vy", "Vz", "P"):
-            recvs[f] = exchange_recv_slabs(gg, shapes[f], hws, modes[f],
-                                           getters[f])
+        recvs = {f: exchange_recv_slabs(gg, shapes[f], hws, modes[f],
+                                        getters[f])
+                 for f in ("Vx", "Vy", "Vz", "P")}
 
     def spec(shape, index_map):
         return pl.BlockSpec(shape, index_map)
@@ -496,12 +445,9 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
         "Vz": [(0, (2, ny, nz + 1), c0), (1, (B, 2, nz + 1), ci),
                (2, (B, ny, 2), ci)],
     }
-    from .pallas_common import AXIS_OF
+    from .pallas_common import add_all_recvs
 
-    for field, kinds in _wave_recv_kinds(all_self):
-        rows = [ss for k in kinds for ss in all_specs[field]
-                if ss[0] == AXIS_OF[k]]
-        add_recvs(field, kinds, rows)
+    add_all_recvs(operands, in_specs, modes, recvs, all_specs, all_self)
 
     def out_shape_of(a):
         return out_shape_with_vma(a, operands)
@@ -557,7 +503,9 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
     from .pallas_halo import halo_write_inplace
 
     if all_self:
-        plane0, planeN = _vx_extra_planes_self(
+        from .pallas_common import vx_extra_planes_self
+
+        plane0, planeN = vx_extra_planes_self(
             Vx, Vxn, recvs["Vx"], modes["Vx"], self_ols["Vx"], nx)
     else:
         plane0, planeN = vx_extra_plane_slabs(Vx, Vxn, recvs["Vx"],
@@ -565,31 +513,3 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
     Vxn = halo_write_inplace(Vxn, plane0, planeN, dim=0, hw=1,
                              interpret=interpret)
     return (Pn, Vxn, Vyn, Vzn)
-
-
-def _vx_extra_planes_self(Vx, Vxn, recvs_vx, modes_vx, ols_vx, nx):
-    """Final Vx planes 0 and nx on an ALL-SELF grid: both x halo planes
-    come from the raw updated source slabs (plane 0 <- updated plane
-    nx-2, plane nx <- updated plane 2; `sendrecv_halo_local` routing)
-    with the z-then-y in-plane selects applied — the same order/argument
-    as `_self_deliver`. When x doesn't exchange, plane 0 is already final
-    in the kernel output and plane nx keeps its raw values + selects."""
-    import jax.numpy as jnp
-    from jax import lax
-
-    ol_y, ol_z = ols_vx
-
-    def selects(plane):
-        # the same z-then-y in-plane routing as the kernel's deliveries
-        # (x disabled: these ARE the x planes)
-        return _self_deliver(plane[0], 0, 1, (False, modes_vx[1],
-                                              modes_vx[2]), None,
-                             ol_y, ol_z)[None]
-
-    if modes_vx[0]:
-        plane0 = selects(recvs_vx[0][0])            # raw updated plane nx-2
-        planeN = selects(recvs_vx[0][1])            # raw updated plane 2
-    else:
-        plane0 = lax.slice_in_dim(Vxn, 0, 1, axis=0)
-        planeN = selects(lax.slice_in_dim(Vx, nx, nx + 1, axis=0))
-    return plane0, planeN

@@ -105,18 +105,22 @@ def sync(tree):
     (foreign shardings, no grid): one element per device shard —
     ``shard.data`` is locally addressable even for multi-host arrays.
     """
+    tree, done = _sync_strong(tree)
+    if not done:
+        _sync_slow(tree)
+    return tree
+
+
+def _sync_slow(tree) -> None:
+    """Per-shard scalar-fetch fallback drain (see `sync`)."""
     import jax
     import numpy as np
 
-    tree, done = _sync_strong(tree)
-    if done:
-        return tree
     for leaf in jax.tree_util.tree_leaves(tree):
         if isinstance(leaf, jax.Array):
             for shard in leaf.addressable_shards:
                 d = shard.data
                 np.asarray(d[(0,) * d.ndim] if d.ndim else d)
-    return tree
 
 
 def _device_barrier() -> None:
@@ -164,7 +168,7 @@ def _sync_then_barrier(sync_on) -> None:
     if sync_on is not None:
         _, strong = _sync_strong(sync_on)
         if not strong:
-            sync(sync_on)
+            _sync_slow(sync_on)
     if strong:
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
