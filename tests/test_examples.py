@@ -37,3 +37,16 @@ def test_vis_example(tmp_path):
     out = _run("diffusion3D_multixpu.py", tmp_path)
     wrote = [p.name for p in tmp_path.iterdir()]
     assert any(n.startswith("diffusion3D") for n in wrote), (out, wrote)
+
+
+def test_acoustic_example(tmp_path):
+    out = _run("acoustic3D_multixpu.py", tmp_path)
+    assert "P interior" in out
+
+
+def test_stokes_example(tmp_path):
+    out = _run("stokes3D_multixpu.py", tmp_path)
+    assert "PT iterations" in out
+    # residuals must DROP across the printed checks
+    errs = [float(m) for m in re.findall(r"max\|divV\|=([0-9.e+-]+)", out)]
+    assert len(errs) >= 2 and errs[-1] < errs[0]
