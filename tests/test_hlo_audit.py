@@ -218,6 +218,33 @@ def test_fused_stokes_permutes():
     _assert_slab_sized_permutes(hlo, (8, 8, 16))
 
 
+def test_fused_acoustic_all_self_no_collectives():
+    """The all-self fast path (single shard, periodic everywhere) must
+    emit NO collectives: deliveries are in-plane selects / raw source
+    slabs inside the kernel (`pallas_common.self_deliver`)."""
+    from implicitglobalgrid_tpu.models import init_acoustic3d, make_acoustic_run
+
+    igg.init_global_grid(8, 8, 16, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    state, p = init_acoustic3d(dtype=np.float32)
+    fn = make_acoustic_run(p, 1, impl="pallas_interpret")
+    hlo = fn.lower(*state).compile().as_text()
+    assert _count_collective_permutes(hlo) == 0
+    assert "all-reduce" not in hlo and "all-gather" not in hlo
+
+
+def test_fused_stokes_all_self_no_collectives():
+    from implicitglobalgrid_tpu.models import init_stokes3d, make_stokes_run
+
+    igg.init_global_grid(8, 8, 16, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    state, p = init_stokes3d(dtype=np.float32)
+    fn = make_stokes_run(p, 1, impl="pallas_interpret")
+    hlo = fn.lower(*state).compile().as_text()
+    assert _count_collective_permutes(hlo) == 0
+    assert "all-reduce" not in hlo and "all-gather" not in hlo
+
+
 def test_permute_count_with_halowidth_2():
     """halowidth>1 exchanges still cost one pair per axis (slab width is
     static, not a per-row loop)."""
