@@ -1,0 +1,49 @@
+"""bench_util: the emit/device-tagging contract and the two-point
+steady-state measurement (the method every bench rate flows through)."""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import bench_util
+import implicitglobalgrid_tpu as igg
+
+
+def test_emit_tags_device_fields(capsys):
+    row = bench_util.emit({"metric": "m", "value": 1.0, "unit": "u"})
+    out = capsys.readouterr().out
+    assert row["platform"] == "cpu" and row["n_devices"] >= 1
+    assert '"metric": "m"' in out
+
+
+def test_two_point_slope_and_fallback():
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2, periodx=1,
+                         quiet=True)
+    try:
+        calls = []
+
+        def chunk(c):
+            # work proportional to c, plus a fixed per-call cost
+            import time
+
+            calls.append(c)
+            time.sleep(0.02 + 0.004 * c)
+
+        s = bench_util.two_point(chunk, 5, 15, reps=1)
+        # slope recovers the per-step cost, NOT the fixed 20ms/call part
+        assert 0.002 < s < 0.008, s
+        # warms both windows, then one timed run each
+        assert calls == [5, 15, 5, 15]
+
+        # non-positive slope falls back to the inclusive big-window rate
+        def flat(c):
+            import time
+
+            time.sleep(0.01)
+
+        s2 = bench_util.two_point(flat, 5, 15, reps=1)
+        assert s2 > 0
+    finally:
+        igg.finalize_global_grid()
