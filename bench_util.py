@@ -2,12 +2,23 @@
 
 Round-1 lesson: the driver's TPU capture failed because `jax.devices()` threw
 on a transient backend-init error and `bench.py` died with a stack trace
-instead of a JSON line.  Every bench entry point now runs through
-:func:`run_with_retries`:
+instead of a JSON line.  Round-3 lesson (BENCH_r03.json, rc=124): two more
+failure modes — a leaked ``IGG_BENCH_CHILD`` in the invoking environment sent
+the script straight down the unsupervised child path, and an unavailable TPU
+backend burned the whole driver timeout in backend-init retries.  Every bench
+entry point now runs through :func:`run_with_retries`:
 
+- :func:`is_child` only accepts a marker stamped with the supervising
+  parent's own pid, so an inherited/leaked env var can never bypass
+  supervision;
+- before the first attempt the backend is probed in a throwaway subprocess
+  with a hard timeout; if the probe fails, the run falls back to ``--cpu``
+  immediately and the emitted rows carry a ``fallback`` note;
 - the measurement runs in a fresh *child process* per attempt, so a cached
   backend-init failure in jax's ``xla_bridge`` can never poison a retry;
-- attempts back off (5s, 15s, 30s, 60s);
+- a total wall-clock budget (``IGG_BENCH_BUDGET`` seconds, default
+  ``_DEFAULT_BUDGET`` = 3000) bounds probe + attempts + fallback so a
+  JSON line always lands inside any driver timeout larger than that;
 - on unrecoverable failure the parent still prints one JSON line
   ``{"metric": ..., "value": null, "error": ...}`` and exits 0, so the driver
   always records a parseable row.
@@ -26,8 +37,24 @@ import sys
 import time
 
 _CHILD_ENV = "IGG_BENCH_CHILD"
-_BACKOFFS = (5, 15, 30, 60)
-_ATTEMPT_TIMEOUT = 2400  # seconds per child attempt (the full-evidence bench runs 7 configs + the kernel checks)
+_PROBE_TIMEOUT = 150  # seconds for the throwaway backend probe
+_CPU_RESERVE = 500    # budget kept back for the --cpu fallback attempt
+
+# Default budget: probe (<=150s) + a full-evidence TPU attempt (measured
+# ~900s healthy: dominated by ~10 tunnel compiles + the pallas_check
+# subprocess, see bench.py) with ~2.3x headroom + the CPU-fallback reserve.
+# Killing a healthy TPU run is the worst outcome (a killed TPU-attached
+# process wedges the chip claim) — size generously; if the DRIVER's own
+# timeout is smaller, the driver kills us either way and the budget only
+# changes who does it.
+_DEFAULT_BUDGET = 3000.0
+
+
+def _budget() -> float:
+    try:
+        return float(os.environ.get("IGG_BENCH_BUDGET", str(_DEFAULT_BUDGET)))
+    except ValueError:
+        return _DEFAULT_BUDGET
 
 
 def device_fields() -> dict:
@@ -52,23 +79,123 @@ def emit(row: dict) -> dict:
     return row
 
 
-def run_with_retries(metric: str, unit: str, argv: list[str] | None = None) -> None:
-    """Re-exec the calling script as a child process with retries.
+def child_env() -> dict:
+    """Environment for spawning a measurement child of THIS process: the
+    marker carries our pid plus a random token, so neither a leaked ``1``
+    (round-3 driver environment) nor a stale marker from another run can
+    route a fresh invocation down the unsupervised child path."""
+    import secrets
+
+    return {**os.environ,
+            _CHILD_ENV: f"{os.getpid()}:{secrets.token_hex(8)}"}
+
+
+def probe_backend(timeout: float = _PROBE_TIMEOUT, platform: str | None = None):
+    """Check a jax backend in a throwaway subprocess.
+
+    ``platform=None`` probes the DEFAULT backend — on this image that is
+    the axon/TPU tunnel whenever it registers, which is exactly what the
+    bench needs to know about.  (Note ``JAX_PLATFORMS`` env is NOT a
+    reliable override here: the axon register re-forces
+    ``jax_platforms="axon,cpu"`` at import; only an in-process
+    ``jax.config.update`` after import wins, which is what ``platform=``
+    does and what ``bench.py --cpu`` does.)
+
+    Returns ``None`` when the backend came up, else a one-line failure
+    description.  A hard timeout bounds the hang-in-backend-init failure
+    mode (the probe holds no TPU program when killed, unlike a measurement
+    child, so killing it is safe)."""
+    force = (f"jax.config.update('jax_platforms', {platform!r}); "
+             if platform else "")
+    code = (f"import jax; {force}d = jax.devices()[0]; "
+            "print('IGG_PROBE_OK', d.platform, d.device_kind)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return f"backend probe timed out after {timeout:.0f}s"
+    except Exception as e:  # pragma: no cover - spawn failure
+        return f"backend probe failed to spawn: {e!r}"
+    if proc.returncode == 0 and "IGG_PROBE_OK" in proc.stdout:
+        return None
+    return ("backend probe rc=%d: %s"
+            % (proc.returncode, (proc.stderr or proc.stdout or "")[-600:]))
+
+
+def _forward_rows(stdout: str, fallback_note) -> None:
+    """Print the child's JSON rows, tagging each with the fallback note."""
+    for ln in stdout.splitlines():
+        s = ln.strip()
+        if not s.startswith("{"):
+            continue
+        if fallback_note is not None:
+            try:
+                row = json.loads(s)
+                row["fallback"] = fallback_note
+                s = json.dumps(row)
+            except Exception:
+                pass
+        print(s)
+
+
+def run_with_retries(metric: str, unit: str, argv: list[str] | None = None,
+                     probe_platform: str | None = None) -> None:
+    """Re-exec the calling script as a supervised child process.
 
     The calling script's ``__main__`` must branch on :func:`is_child` — the
-    child runs the real measurement; the parent (this function) supervises.
-    Never raises; always prints >=1 JSON line; always exits 0.
+    child runs the real measurement; the parent (this function) supervises:
+    backend probe → (TPU attempts) → automatic ``--cpu`` fallback, all under
+    one wall-clock budget.  Never raises; always prints >=1 JSON line;
+    always exits 0 (unless ``IGG_BENCH_STRICT=1``).
+
+    ``probe_platform`` forces the pre-flight probe onto a named backend
+    (tests); ``None`` probes the default (accelerator) backend.
     """
-    argv = argv if argv is not None else sys.argv
+    argv = list(argv) if argv is not None else list(sys.argv)
+    deadline = time.monotonic() + _budget()
+    cpu_mode = "--cpu" in argv
+    fallback_note = None
     last_tail = ""
-    for attempt, backoff in enumerate(_BACKOFFS + (None,)):
+
+    if not cpu_mode:
+        probe_err = probe_backend(
+            min(_PROBE_TIMEOUT, max(10.0, deadline - time.monotonic()
+                                    - _CPU_RESERVE)),
+            platform=probe_platform)
+        if probe_err is not None:
+            sys.stderr.write(f"[bench_util] {probe_err}; "
+                             "falling back to --cpu\n")
+            fallback_note = "tpu_unavailable: " + probe_err[-300:]
+            argv.append("--cpu")
+            cpu_mode = True
+
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        # On the accelerator path, keep enough budget back to still run one
+        # CPU-fallback attempt afterwards.
+        attempt_timeout = remaining - (0 if cpu_mode else _CPU_RESERVE)
+        if attempt_timeout < 30:
+            if not cpu_mode:
+                # no room for an accelerator attempt, but the reserve can
+                # still buy the CPU fallback — use it instead of giving up
+                fallback_note = "tpu_skipped: budget too small for an " \
+                                "accelerator attempt"
+                argv.append("--cpu")
+                cpu_mode = True
+                continue
+            last_tail = last_tail or "wall-clock budget exhausted"
+            break
         try:
             proc = subprocess.run(
                 [sys.executable, *argv],
-                env={**os.environ, _CHILD_ENV: "1"},
+                env=child_env(),
                 capture_output=True,
                 text=True,
-                timeout=_ATTEMPT_TIMEOUT,
+                timeout=attempt_timeout,
             )
             if proc.returncode == 0 and any(
                 ln.strip().startswith("{") for ln in proc.stdout.splitlines()
@@ -76,27 +203,32 @@ def run_with_retries(metric: str, unit: str, argv: list[str] | None = None) -> N
                 # Forward stdout only on success: a failed attempt may have
                 # printed partial rows which would duplicate/contradict the
                 # retry's rows in the driver's line-parsed capture.
-                sys.stdout.write(proc.stdout)
+                _forward_rows(proc.stdout, fallback_note)
                 sys.stdout.flush()
                 sys.exit(0)
             last_tail = (proc.stderr or proc.stdout or "")[-2000:]
-        except subprocess.TimeoutExpired as e:
-            last_tail = f"attempt timed out after {_ATTEMPT_TIMEOUT}s: {e}"
+        except subprocess.TimeoutExpired:
+            last_tail = f"attempt timed out after {attempt_timeout:.0f}s"
         except Exception as e:  # subprocess spawn failure etc.
             last_tail = repr(e)
-        sys.stderr.write(
-            f"[bench_util] attempt {attempt + 1} failed"
-            + (f"; retrying in {backoff}s\n" if backoff else "; giving up\n")
-        )
+        sys.stderr.write(f"[bench_util] attempt {attempt} "
+                         f"({'cpu' if cpu_mode else 'accel'}) failed\n")
         sys.stderr.write(last_tail + "\n")
-        if backoff is None:
+        if not cpu_mode:
+            # One accelerator attempt only — a post-probe failure is almost
+            # never transient; spend the remaining budget on the fallback.
+            fallback_note = ("tpu_attempt_failed: " + last_tail[-300:])
+            argv.append("--cpu")
+            cpu_mode = True
+        elif attempt >= 3:
             break
-        time.sleep(backoff)
+        time.sleep(5)
     print(json.dumps({
         "metric": metric,
         "value": None,
         "unit": unit,
         "error": last_tail[-1000:],
+        "fallback": fallback_note,
     }))
     # Exit-0-with-null-row is the contract the driver needs (a parseable row
     # no matter what); CI needs red builds instead — IGG_BENCH_STRICT=1.
@@ -104,10 +236,19 @@ def run_with_retries(metric: str, unit: str, argv: list[str] | None = None) -> N
 
 
 def is_child() -> bool:
-    return os.environ.get(_CHILD_ENV) == "1"
+    """True only when the marker has the ``<ppid>:<token>`` shape stamped
+    by :func:`child_env` and the pid half names OUR direct parent — a
+    leaked ``IGG_BENCH_CHILD=1`` from the invoking environment (the
+    round-3 failure: it sent `bench.py` straight down the unsupervised
+    child path, even matching ppid 1 in a container) cannot bypass
+    supervision."""
+    val = os.environ.get(_CHILD_ENV, "")
+    pid, sep, token = val.partition(":")
+    return bool(sep) and len(token) >= 8 and pid == str(os.getppid())
 
 
-def two_point(run_chunk, c1: int, c2: int, reps: int = 2) -> float:
+def two_point(run_chunk, c1: int, c2: int, reps: int = 2,
+              timer=None) -> float:
     """Steady-state seconds/step via two warmed one-call chunk windows.
 
     ``run_chunk(c)`` must execute ONE chunk call of ``c`` steps and drain
@@ -117,19 +258,35 @@ def two_point(run_chunk, c1: int, c2: int, reps: int = 2) -> float:
     ``(t(c2)-t(c1))/(c2-c1)`` is the pure per-step device time — the same
     amortized steady-state quantity the reference's 100k-step wall-clock
     anchor reports (`reference README.md:163-167`). Each window is
-    measured ``reps`` times, keeping the minimum."""
-    import implicitglobalgrid_tpu as igg
+    measured ``reps`` times, keeping the minimum.
+
+    ``timer(fn) -> seconds`` defaults to the barrier-synchronized
+    ``igg.tic()``/``igg.toc()`` pair; tests inject a fake clock.
+
+    After each call, ``two_point.last`` records ``{"method", "t1", "t2"}``;
+    ``method`` is ``"two-point"`` for a true slope or
+    ``"inclusive-fallback"`` when timer jitter produced ``t2 <= t1`` and
+    the bigger window's inclusive rate was returned instead (that rate
+    re-includes the fixed per-call cost — emitted rows should carry the
+    distinction)."""
+    if timer is None:
+        import implicitglobalgrid_tpu as igg
+
+        def timer(fn):
+            igg.tic()
+            fn()
+            return igg.toc()
 
     run_chunk(c1)
     run_chunk(c2)  # warm both programs + both drain signatures
 
-    def timed(c):
-        igg.tic()
-        run_chunk(c)
-        return igg.toc()
-
-    t1 = min(timed(c1) for _ in range(reps))
-    t2 = min(timed(c2) for _ in range(reps))
+    t1 = min(timer(lambda: run_chunk(c1)) for _ in range(reps))
+    t2 = min(timer(lambda: run_chunk(c2)) for _ in range(reps))
     if t2 <= t1:  # timer jitter on tiny windows: never emit a <=0 slope;
+        two_point.last = {"method": "inclusive-fallback", "t1": t1, "t2": t2}
         return t2 / c2  # fall back to the bigger window's inclusive rate
+    two_point.last = {"method": "two-point", "t1": t1, "t2": t2}
     return (t2 - t1) / (c2 - c1)
+
+
+two_point.last = None

@@ -49,7 +49,10 @@ from .tools import (
 )
 from .utils.timing import tic, toc, barrier, sync
 from .utils.profiling import trace, annotate, overlap_stats, op_breakdown
-from .utils.checkpoint import save_checkpoint, restore_checkpoint, load_checkpoint
+from .utils.checkpoint import (
+    save_checkpoint, restore_checkpoint, load_checkpoint,
+    save_checkpoint_sharded, restore_checkpoint_sharded,
+)
 from .utils import exceptions
 
 __version__ = "0.1.0"
@@ -65,6 +68,7 @@ __all__ = [
     "Field", "wrap_field", "extract", "local_shape_of", "stacked_shape",
     "x_g_vec", "y_g_vec", "z_g_vec", "coords_g",
     "save_checkpoint", "restore_checkpoint", "load_checkpoint",
+    "save_checkpoint_sharded", "restore_checkpoint_sharded",
     "d_xa", "d_ya", "d_za", "d_xi", "d_yi", "d_zi", "inn",
     # state/introspection
     "AXIS_NAMES", "NDIMS", "PROC_NULL", "GlobalGrid", "global_grid",

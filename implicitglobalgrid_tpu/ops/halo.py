@@ -473,6 +473,18 @@ def update_halo(*fields, dims=None):
     Group several fields in one call for best performance — all their permutes
     compile into one program and pipeline (reference performance note,
     `update_halo.jl:17-18`).
+
+    Example (doctest):
+
+    >>> import numpy as np
+    >>> import implicitglobalgrid_tpu as igg
+    >>> _ = igg.init_global_grid(4, 4, 4, dimx=2, dimy=2, dimz=2,
+    ...                          periodx=1, quiet=True)
+    >>> T = igg.ones_g(dtype=np.float32)    # stacked (8, 8, 8)
+    >>> T = igg.update_halo(T)
+    >>> tuple(T.shape)
+    (8, 8, 8)
+    >>> igg.finalize_global_grid()
     """
     import jax.numpy as jnp
 

@@ -135,7 +135,16 @@ def hide_communication(update_fn, T, *aux, radius: int = 1, dims=None,
         lo, hi = interior_lohi[d]
         int_out = lax.slice_in_dim(int_out, r, r + (hi - lo), axis=d)
 
-    # (4) stitch interior into the exchanged array.
+    # (4) stitch interior into the exchanged array. The barrier stops XLA
+    # from fusing the (permute-independent) interior compute INTO the
+    # stitch — which depends on every permute and would serialize the
+    # interior after the collectives, defeating the whole construction
+    # (observed on the CPU backend: the interior stencil landed inside the
+    # ROOT stitch fusion). With the barrier, the interior stays its own
+    # fusion with no path to/from the permutes, which is exactly what the
+    # latency-hiding scheduler needs to run it under them
+    # (tests/test_hlo_audit.py::test_overlap_interior_independent_of_permutes).
+    exchanged, int_out = lax.optimization_barrier((exchanged, int_out))
     starts = [0] * T.ndim
     for d in ex_dims:
         starts[d] = interior_lohi[d][0]

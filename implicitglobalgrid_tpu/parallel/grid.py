@@ -167,8 +167,28 @@ def init_global_grid(
         nprocs = int(np.prod(dims))
     else:
         nprocs = len(devices)
-        # Free dims of size-1 grid dimensions were pinned to 1 above; respect
-        # divisibility of the rest.
+        # Free dims of size-1 grid dimensions were pinned to 1 above. When
+        # the pool size is not a multiple of the fixed dims (e.g. 6 devices
+        # with dimx=4), fall back to the largest usable device subset —
+        # mirroring the fully-fixed case, where a subset is already allowed
+        # (unlike MPI, the device pool is not the job size).
+        fixed = int(np.prod(dims[dims > 0])) if np.any(dims > 0) else 1
+        if fixed > nprocs:
+            raise InvalidArgumentError(
+                f"The fixed dims require {fixed} shard(s) but only {nprocs} "
+                "device(s) are available; reduce dimx/dimy/dimz or pass a "
+                "larger device pool via devices=."
+            )
+        if nprocs % fixed != 0:
+            import warnings
+
+            new = (nprocs // fixed) * fixed
+            warnings.warn(
+                f"Device pool of {nprocs} is not a multiple of the fixed "
+                f"dims product ({fixed}); using {new} device(s) — "
+                f"{nprocs - new} idle. Adjust dimx/dimy/dimz or pass "
+                "devices= to use the full pool.")
+            nprocs = new
     dims = dims_create(nprocs, dims)
     if int(np.prod(dims)) > len(devices):
         raise InvalidArgumentError(

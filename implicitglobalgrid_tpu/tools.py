@@ -149,7 +149,23 @@ def _x_g(ix, dcoord, A, dim: int, coords=None, layout=None):
 
 def x_g(ix, dx, A, coords=None, *, layout=None):
     """Global x-coordinate of 0-based local index ``ix`` in array ``A``
-    (reference `tools.jl:98-107`)."""
+    (reference `tools.jl:98-107`).
+
+    Examples (run as doctests, like the reference's doctested API docs,
+    `tools.jl:67-96`):
+
+    >>> import implicitglobalgrid_tpu as igg
+    >>> _ = igg.init_global_grid(4, 4, 4, dimx=2, dimy=1, dimz=1,
+    ...                          quiet=True)
+    >>> igg.nx_g()          # 2*(4-2) + 2: the implicit-global-size formula
+    6
+    >>> A = igg.zeros_g()   # stacked global array: shape (8, 4, 4)
+    >>> float(igg.x_g(0, 0.5, A))   # first cell of the left shard
+    0.0
+    >>> float(igg.x_g(4, 0.5, A))   # right shard overlaps by 2 cells
+    1.0
+    >>> igg.finalize_global_grid()
+    """
     return _x_g(ix, dx, A, 0, coords, layout)
 
 

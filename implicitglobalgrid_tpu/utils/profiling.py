@@ -99,7 +99,9 @@ _planes_cache: dict = {}
 def _device_planes(log_dir: str):
     """Device planes of the newest capture; memoized on the capture files'
     (path, mtime, size) so overlap_stats + op_breakdown on the same trace
-    decode the (potentially large) protobuf once."""
+    decode the (potentially large) protobuf once. Only the most recent
+    trace is retained (size-1 cache): analyzing several large traces in
+    one process must not accumulate all their decoded events."""
     import os
 
     from .xplane import find_xplane_files, parse_xspace
@@ -114,6 +116,7 @@ def _device_planes(log_dir: str):
         for plane in parse_xspace(path):
             if plane.name.startswith("/device:"):
                 planes.append(plane)
+    _planes_cache.clear()
     _planes_cache[log_dir] = (key, planes)
     return planes
 

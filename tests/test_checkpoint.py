@@ -46,6 +46,76 @@ def test_resume_continues_simulation(tmp_path):
                        rtol=0, atol=0)
 
 
+def test_sharded_save_restore_roundtrip(tmp_path):
+    """Pod-scale path: per-process shard files, restore by block coords —
+    no host materializes the global state (round-3 verdict item 7)."""
+    _init()
+    d = str(tmp_path / "ckpt_dir")
+    T = igg.device_put_g(np.arange(1000, dtype=np.float64).reshape(10, 10, 10))
+    Cp = igg.ones_g(dtype=np.float32)
+    igg.save_checkpoint_sharded(d, {"T": T, "Cp": Cp}, step=7)
+    import os
+
+    assert os.path.exists(os.path.join(d, "meta.npz"))
+    assert os.path.exists(os.path.join(d, "shards_p0.npz"))
+    state, step = igg.restore_checkpoint_sharded(d)
+    assert step == 7
+    assert np.array_equal(np.asarray(state["T"]), np.asarray(T))
+    assert state["Cp"].dtype == np.float32
+    assert np.array_equal(np.asarray(state["Cp"]), np.asarray(Cp))
+    # restored arrays carry the grid sharding
+    r = igg.update_halo(state["T"])
+    assert np.asarray(r).shape == (10, 10, 10)
+
+    # every block lives in the shard file, not a gathered array: the file
+    # holds 8 blocks of 5x5x5 per array
+    with np.load(os.path.join(d, "shards_p0.npz")) as z:
+        tkeys = [k for k in z.files if k.startswith("__igg_arr__T__")]
+        assert len(tkeys) == 8
+        assert all(z[k].shape == (5, 5, 5) for k in tkeys)
+
+
+def test_sharded_topology_mismatch_and_missing(tmp_path):
+    _init()
+    d = str(tmp_path / "ckpt_dir")
+    igg.save_checkpoint_sharded(d, {"A": igg.ones_g()})
+    igg.finalize_global_grid()
+    igg.init_global_grid(5, 5, 5, dimx=4, dimy=2, dimz=1, periodx=1,
+                         quiet=True)
+    with pytest.raises(IncoherentArgumentError, match="topology mismatch"):
+        igg.restore_checkpoint_sharded(d)
+    # strict=False is NOT an escape hatch here: blocks are keyed by the
+    # saved decomposition (the single-file path reshards; this one cannot)
+    with pytest.raises(IncoherentArgumentError, match="cannot reshard"):
+        igg.restore_checkpoint_sharded(d, strict=False)
+    with pytest.raises(InvalidArgumentError, match="meta not found"):
+        igg.restore_checkpoint_sharded(str(tmp_path / "nope"))
+    igg.finalize_global_grid()
+    _init()
+    with pytest.raises(InvalidArgumentError, match="'__'"):
+        igg.save_checkpoint_sharded(d, {"bad__key": igg.ones_g()})
+
+
+def test_sharded_stale_files_cleaned_and_ignored(tmp_path):
+    """Leftover shard files from an earlier save with more processes must
+    neither be read back (meta records the file count) nor survive a
+    re-save (process 0 removes indices >= process_count)."""
+    import os
+
+    _init()
+    d = str(tmp_path / "ck")
+    igg.save_checkpoint_sharded(d, {"A": igg.ones_g()})
+    stale = os.path.join(d, "shards_p7.npz")
+    np.savez(stale, junk=np.zeros(3))
+    st, _ = igg.restore_checkpoint_sharded(d)  # stale file ignored
+    assert np.array_equal(np.asarray(st["A"]), np.ones((10, 10, 10)))
+    igg.save_checkpoint_sharded(d, {"A": igg.ones_g()})  # re-save cleans
+    assert not os.path.exists(stale)
+    os.remove(os.path.join(d, "shards_p0.npz"))
+    with pytest.raises(InvalidArgumentError, match="incomplete"):
+        igg.restore_checkpoint_sharded(d)
+
+
 def test_load_without_grid(tmp_path):
     _init()
     p = str(tmp_path / "ckpt.npz")

@@ -1,0 +1,23 @@
+"""Execute the API docstring examples — parity with the reference's
+doctested API docs (`/root/reference/src/tools.jl:67-96`; its CI doctest
+job, `docs/make.jl`). Each example is self-contained (inits and finalizes
+its own grid) so the suite's grid hygiene holds."""
+
+import doctest
+
+import pytest
+
+import implicitglobalgrid_tpu.ops.halo as halo
+import implicitglobalgrid_tpu.tools as tools
+import implicitglobalgrid_tpu.utils.checkpoint as checkpoint
+
+
+@pytest.mark.parametrize("module,min_examples", [
+    (tools, 4), (halo, 2), (checkpoint, 6),
+])
+def test_docstring_examples(module, min_examples):
+    res = doctest.testmod(module, verbose=False)
+    assert res.failed == 0, f"{module.__name__}: {res.failed} doctest failures"
+    assert res.attempted >= min_examples, (
+        f"{module.__name__}: expected >= {min_examples} doctest examples, "
+        f"found {res.attempted}")

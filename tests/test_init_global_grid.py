@@ -49,6 +49,25 @@ def test_fixed_dims_use_device_subset():
     assert nprocs == 2 and list(dims) == [2, 1, 1]
 
 
+def test_partially_fixed_dims_use_device_subset():
+    # 8-device pool, dimx=3: 8 is not a multiple of 3 — fall back to the
+    # largest usable subset (6 devices, free dims filled over 6/3=2)
+    # instead of a divisibility error (round-3 verdict item 9).
+    me, dims, nprocs, *_ = igg.init_global_grid(
+        4, 4, 4, dimx=3, quiet=True)
+    assert nprocs == 6 and dims[0] == 3 and int(np.prod(dims)) == 6
+    igg.finalize_global_grid()
+
+    # prime fixed dim larger than any divisor: subset of exactly `fixed`
+    me, dims, nprocs, *_ = igg.init_global_grid(8, 8, 8, dimx=5, quiet=True)
+    assert nprocs == 5 and list(dims) == [5, 1, 1]
+    igg.finalize_global_grid()
+
+    # fixed dims exceeding the pool: actionable error
+    with pytest.raises(InvalidArgumentError, match="device pool"):
+        igg.init_global_grid(32, 32, 32, dimx=16, quiet=True)
+
+
 def test_default_halowidths():
     igg.init_global_grid(8, 8, 8, overlaps=(4, 4, 2), quiet=True)
     gg = igg.global_grid()
@@ -86,8 +105,8 @@ def test_error_paths():
         igg.init_global_grid(8, 8, 8, halowidths=(2, 1, 1), quiet=True)  # hw > ol//2
     with pytest.raises(InvalidArgumentError):
         igg.init_global_grid(4, 4, 4, device_type="rocm", quiet=True)
-    with pytest.raises(IncoherentArgumentError):
-        igg.init_global_grid(4, 4, 4, dimx=5, dimy=2, quiet=True)  # 8 not divisible by 10
+    with pytest.raises(InvalidArgumentError, match="device pool"):
+        igg.init_global_grid(4, 4, 4, dimx=5, dimy=2, quiet=True)  # fixed 10 > 8 devices
     with pytest.raises(InvalidArgumentError):
         igg.init_global_grid(4, 4, 4, dimx=5, dimy=2, dimz=1, quiet=True)  # 10 > 8 devices
     assert not igg.grid_is_initialized()

@@ -106,6 +106,21 @@ _CHILD = textwrap.dedent("""
     else:
         assert sub is None
 
+    # sharded checkpoint: each process writes ONLY its addressable shards
+    # (no full gather anywhere — the pod-scale path, verdict r3 item 7),
+    # then restore reassembles the exact state by block coordinates
+    ckdir = os.path.join(os.path.dirname(os.path.abspath(sys.argv[0])),
+                         "ckpt_sharded")
+    igg.save_checkpoint_sharded(ckdir, {"A": res}, step=3)
+    with np.load(os.path.join(ckdir, f"shards_p{pid}.npz")) as z:
+        own_blocks = [k for k in z.files if k.startswith("__igg_arr__A__")]
+        assert len(own_blocks) == ndev, own_blocks   # only OUR shards
+    st, sp = igg.restore_checkpoint_sharded(ckdir)
+    assert sp == 3
+    g2 = igg.gather(st["A"], root=0)
+    if pid == 0:
+        assert np.array_equal(np.asarray(g2), enc), "sharded restore failed"
+
     igg.finalize_global_grid()
     print(f"MP_OK {pid}", flush=True)
 """)
