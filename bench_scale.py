@@ -33,54 +33,65 @@ import subprocess
 import sys
 import textwrap
 
-_CHILD = textwrap.dedent("""
-    import json, os, sys, time
+# One measurement template; the single- and multi-process variants differ
+# only in their preamble (device count / distributed init) and row extras,
+# injected via format fields — so the measured quantities can never drift
+# between the two.
+_MEASURE_TEMPLATE = """
+import json, os, sys, time
 
+{preamble}
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.models import init_diffusion3d, make_run
+
+dims = [int(d) for d in igg.dims_create(n, (0, 0, 0))]
+t0 = time.perf_counter()
+igg.init_global_grid(8, 8, 8, dimx=dims[0], dimy=dims[1], dimz=dims[2],
+                     periodx=1, periody=1, periodz=1, quiet=True,
+                     **init_kw)
+t_init = time.perf_counter() - t0
+
+T, Cp, p = init_diffusion3d(dtype=np.float32)
+run = make_run(p, nt_chunk=1, impl="xla")
+t0 = time.perf_counter()
+compiled = run.lower(T, Cp).compile()
+t_compile = time.perf_counter() - t0
+hlo = compiled.as_text()
+permutes = hlo.count("collective-permute-start") or \\
+    hlo.count("collective-permute(")
+
+out = jax.block_until_ready(run(T, Cp))
+t0 = time.perf_counter()
+out = jax.block_until_ready(run(*out))
+t_exec = time.perf_counter() - t0
+
+row = {{
+    "n_devices": n, "dims": dims, "t_init_s": round(t_init, 3),
+    "t_compile_s": round(t_compile, 3),
+    "collective_permutes": permutes,
+    "hlo_bytes": len(hlo), "t_exec_s": round(t_exec, 4),
+}}
+row.update(extras)
+if emit:
+    print(prefix + json.dumps(row), flush=True)
+"""
+
+_CHILD = _MEASURE_TEMPLATE.format(preamble=textwrap.dedent("""
     n = int(sys.argv[1])
     os.environ["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={n}")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    import numpy as np
+    init_kw = {}
+    extras = {"processes": 1}
+    emit, prefix = True, ""
+"""))
 
-    sys.path.insert(0, "/root/repo")
-    import implicitglobalgrid_tpu as igg
-    from implicitglobalgrid_tpu.models import init_diffusion3d, make_run
-
-    dims = [int(d) for d in igg.dims_create(n, (0, 0, 0))]
-    t0 = time.perf_counter()
-    igg.init_global_grid(8, 8, 8, dimx=dims[0], dimy=dims[1], dimz=dims[2],
-                         periodx=1, periody=1, periodz=1, quiet=True)
-    t_init = time.perf_counter() - t0
-
-    T, Cp, p = init_diffusion3d(dtype=np.float32)
-    run = make_run(p, nt_chunk=1, impl="xla")
-    t0 = time.perf_counter()
-    compiled = run.lower(T, Cp).compile()
-    t_compile = time.perf_counter() - t0
-    hlo = compiled.as_text()
-    permutes = hlo.count("collective-permute-start") or \\
-        hlo.count("collective-permute(")
-
-    out = jax.block_until_ready(run(T, Cp))
-    t0 = time.perf_counter()
-    out = jax.block_until_ready(run(*out))
-    t_exec = time.perf_counter() - t0
-    assert all(np.isfinite(np.asarray(igg.gather(a))).all() for a in out)
-
-    print(json.dumps({
-        "n_devices": n, "dims": dims, "t_init_s": round(t_init, 3),
-        "t_compile_s": round(t_compile, 3),
-        "collective_permutes": permutes,
-        "hlo_bytes": len(hlo), "t_exec_s": round(t_exec, 4),
-        "processes": 1,
-    }))
-""")
-
-_CHILD_MP = textwrap.dedent("""
-    import json, os, sys, time
-
+_CHILD_MP = _MEASURE_TEMPLATE.format(preamble=textwrap.dedent("""
     pid, nproc, port, ndev = (int(sys.argv[1]), int(sys.argv[2]),
                               sys.argv[3], int(sys.argv[4]))
     os.environ["XLA_FLAGS"] = (
@@ -91,42 +102,11 @@ _CHILD_MP = textwrap.dedent("""
     jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
                                num_processes=nproc, process_id=pid)
-    import numpy as np
-
-    sys.path.insert(0, "/root/repo")
-    import implicitglobalgrid_tpu as igg
-    from implicitglobalgrid_tpu.models import init_diffusion3d, make_run
-
     n = nproc * ndev
-    dims = [int(d) for d in igg.dims_create(n, (0, 0, 0))]
-    t0 = time.perf_counter()
-    igg.init_global_grid(8, 8, 8, dimx=dims[0], dimy=dims[1], dimz=dims[2],
-                         periodx=1, periody=1, periodz=1, quiet=True,
-                         init_dist=False, reorder=0)
-    t_init = time.perf_counter() - t0
-
-    T, Cp, p = init_diffusion3d(dtype=np.float32)
-    run = make_run(p, nt_chunk=1, impl="xla")
-    t0 = time.perf_counter()
-    compiled = run.lower(T, Cp).compile()
-    t_compile = time.perf_counter() - t0
-    hlo = compiled.as_text()
-    permutes = hlo.count("collective-permute-start") or \\
-        hlo.count("collective-permute(")
-    out = jax.block_until_ready(run(T, Cp))
-    t0 = time.perf_counter()
-    out = jax.block_until_ready(run(*out))
-    t_exec = time.perf_counter() - t0
-
-    if pid == 0:
-        print("SCALE_MP " + json.dumps({
-            "n_devices": n, "dims": dims, "t_init_s": round(t_init, 3),
-            "t_compile_s": round(t_compile, 3),
-            "collective_permutes": permutes,
-            "hlo_bytes": len(hlo), "t_exec_s": round(t_exec, 4),
-            "processes": nproc, "dcn_axes": "z",
-        }), flush=True)
-""")
+    init_kw = {"init_dist": False, "reorder": 0}
+    extras = {"processes": nproc, "dcn_axes": "z"}
+    emit, prefix = (pid == 0), "SCALE_MP "
+"""))
 
 
 def _free_port():
@@ -223,6 +203,11 @@ def main() -> None:
                 "bounds the v5p-256 extrapolation",
     }
     print(json.dumps(summary), flush=True)
+    # CI gate (same contract as the other benches' IGG_BENCH_STRICT): red
+    # build when a config failed or the program stopped being scale-free.
+    if os.environ.get("IGG_BENCH_STRICT") == "1" and not (
+            len(ok_rows) == len(rows) and summary["scale_free_program"]):
+        sys.exit(1)
 
 
 if __name__ == "__main__":

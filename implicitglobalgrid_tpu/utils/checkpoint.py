@@ -183,7 +183,22 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
     os.makedirs(dirpath, exist_ok=True)
     pidx = jax.process_index()
 
-    payload = {}
+    # One token per SAVE, shared by all processes: restore validates every
+    # shard file against it, so a crash between one process's write and
+    # another's can never silently mix blocks from two different saves
+    # (per-file tmp+rename is atomic; the multi-file SET is not).
+    import secrets
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        token_arr = multihost_utils.broadcast_one_to_all(
+            np.frombuffer(secrets.token_bytes(16), dtype=np.uint8))
+        token = bytes(np.asarray(token_arr)).hex()
+    else:
+        token = secrets.token_hex(16)
+
+    payload = {f"{_META_PREFIX}save_token": np.str_(token)}
     names, shapes, dtypes = [], {}, {}
     for k, v in state.items():
         if not hasattr(v, "addressable_shards"):  # host array: shard first
@@ -205,6 +220,7 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
     if pidx == 0:
         meta = _grid_meta(gg)
         meta[f"{_META_PREFIX}names"] = np.asarray(names)
+        meta[f"{_META_PREFIX}save_token"] = np.str_(token)
         meta[f"{_META_PREFIX}nprocs_files"] = np.int64(jax.process_count())
         meta.update(shapes)
         meta.update(dtypes)
@@ -293,10 +309,21 @@ def restore_checkpoint_sharded(dirpath, *, strict: bool = True):
 
     blocks: dict = {}       # key -> np.ndarray, only keys in `wanted`
     unscanned = list(files)
+    expect_token = str(meta["save_token"]) if "save_token" in meta else None
+    token_key = f"{_META_PREFIX}save_token"
 
     def find_block(key: str):
         while key not in blocks and unscanned:
-            with np.load(unscanned.pop(0)) as z:
+            path = unscanned.pop(0)
+            with np.load(path) as z:
+                if expect_token is not None:
+                    ftok = str(z[token_key]) if token_key in z.files else None
+                    if ftok != expect_token:
+                        raise IncoherentArgumentError(
+                            f"Shard file {path} belongs to a different "
+                            "save than meta.npz (save-token mismatch) — "
+                            "the save was interrupted; do not resume from "
+                            "this checkpoint.")
                 for k in z.files:
                     if k in wanted:
                         blocks[k] = z[k]

@@ -116,6 +116,27 @@ def test_sharded_stale_files_cleaned_and_ignored(tmp_path):
         igg.restore_checkpoint_sharded(d)
 
 
+def test_sharded_interrupted_save_detected(tmp_path):
+    """A crash between one process's shard write and another's must not
+    silently mix saves: every shard file carries the save token and
+    restore validates it against meta."""
+    import os
+    import shutil
+
+    _init()
+    d = str(tmp_path / "ck")
+    igg.save_checkpoint_sharded(d, {"A": igg.ones_g()}, step=1)
+    old_shard = str(tmp_path / "old_shard.npz")
+    shutil.copy(os.path.join(d, "shards_p0.npz"), old_shard)
+    igg.save_checkpoint_sharded(d, {"A": igg.zeros_g()}, step=2)
+    st, sp = igg.restore_checkpoint_sharded(d)
+    assert sp == 2 and float(np.asarray(st["A"]).max()) == 0.0
+    # simulate the crash: meta from save 2, shard file from save 1
+    shutil.copy(old_shard, os.path.join(d, "shards_p0.npz"))
+    with pytest.raises(IncoherentArgumentError, match="save-token"):
+        igg.restore_checkpoint_sharded(d)
+
+
 def test_load_without_grid(tmp_path):
     _init()
     p = str(tmp_path / "ckpt.npz")
