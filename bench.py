@@ -118,12 +118,16 @@ def main() -> None:
     _method_note("headline")
 
     # roofline accounting for the headline row (multi-plane fused kernel:
-    # T read (1+2/P)x + Cp read 1x + T write 1x; XLA path: ~2 passes+Cp)
-    from implicitglobalgrid_tpu.ops.pallas_stencil import mp_planes
+    # T read 1.0x with the VMEM window handoff else (1+2/P)x, + Cp read
+    # 1x + T write 1x; XLA path: ~2 passes+Cp)
+    from implicitglobalgrid_tpu.ops.pallas_stencil import (
+        mp_bytes_per_cell, mp_handoff, mp_planes,
+    )
 
     sds = jax.ShapeDtypeStruct((nx, nx, nx), np.float32)
     P = mp_planes(sds)
-    bytes_per_cell = (3.0 + (2.0 / P if P else 2.0)) * 4
+    bytes_per_cell = float(mp_bytes_per_cell(sds))
+    notes["window_handoff"] = bool(mp_handoff(sds))
     effective_gbps = headline * bytes_per_cell / 1e9
     try:
         kind = jax.devices()[0].device_kind

@@ -163,6 +163,28 @@ def _checks(interpret: bool):
     results.append(run("fused_step_exchange", check_step_exchange_fused))
     igg.finalize_global_grid()
 
+    # --- window-handoff variant: >= 3 windows (128/P=32 -> 4), exercising
+    # the VMEM overlap handoff of `_window_pipeline_handoff` on hardware
+    def check_step_handoff():
+        igg.init_global_grid(128, 64, 256, periodx=1, periody=1,
+                             periodz=1, quiet=True)
+        try:
+            sds = jax.ShapeDtypeStruct((128, 64, 256), np.float32)
+            if not ps.mp_handoff(sds, interpret=interpret):
+                return False, "handoff gate unexpectedly off"
+            Th, Cph, ph = init_diffusion3d(dtype=np.float32)
+            a = np.asarray(igg.gather(run_diffusion(
+                Th, Cph, ph, 2, nt_chunk=2, impl="xla")))
+            b = np.asarray(igg.gather(run_diffusion(
+                Th, Cph, ph, 2, nt_chunk=2,
+                impl="pallas_interpret" if interpret else "pallas")))
+            ok = np.allclose(a, b, rtol=2e-6, atol=2e-5)
+            return ok, f"max_abs_diff={float(np.max(np.abs(a - b))):.3e}"
+        finally:
+            igg.finalize_global_grid()
+
+    results.append(run("fused_step_self_handoff", check_step_handoff))
+
     # --- fused acoustic and Stokes passes (staggered multi-field tiers) ---
     from implicitglobalgrid_tpu.models import (
         init_acoustic3d, init_stokes3d, run_acoustic, run_stokes,

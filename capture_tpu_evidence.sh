@@ -1,0 +1,31 @@
+#!/bin/bash
+# Capture the committed TPU evidence artifacts in one pass (verdict r3
+# item 2). Run when the axon tunnel is UP (check: the bench's backend
+# probe, or tail /tmp/tpu_watch.out in-session). NO timeouts anywhere —
+# a killed TPU-attached process wedges the chip claim for hours.
+#
+#   bash capture_tpu_evidence.sh && git add BENCH_TPU.json \
+#       BENCH_HALO_TPU.json BENCH_PALLAS_TPU.json && git commit
+#
+# Each artifact is the bench's JSON line(s), tagged with platform/
+# device_kind by bench_util.emit; rows with "platform": "cpu" or a
+# "fallback" tag mean the tunnel dropped mid-capture — do not commit those.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== bench.py (full evidence: headline + configs + triad + kernel checks)"
+python bench.py | tee BENCH_TPU.json
+
+echo "== bench_halo.py (standalone exchange GB/s)"
+python bench_halo.py | tee BENCH_HALO_TPU.json
+
+echo "== bench_pallas_check.py (kernel-vs-XLA equality on hardware)"
+python bench_pallas_check.py | tee BENCH_PALLAS_TPU.json
+
+echo "== done; every row's platform tag (null/cpu/fallback rows => do NOT commit):"
+grep -h -o '"platform": [^,]*' BENCH_TPU.json BENCH_HALO_TPU.json \
+    BENCH_PALLAS_TPU.json | sort | uniq -c
+if grep -l '"fallback"' BENCH_TPU.json BENCH_HALO_TPU.json \
+        BENCH_PALLAS_TPU.json; then
+    echo "WARNING: a fallback tag is present — tunnel dropped mid-capture"
+fi

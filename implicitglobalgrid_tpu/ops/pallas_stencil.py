@@ -311,7 +311,8 @@ def _plane_step_recv_kernel(*refs, nx, modes, lam, dt, dx, dy, dz):
     o_ref[0] = u
 
 
-def _mp_step_recv_kernel(*refs, nx, P, modes, lam, dt, dx, dy, dz):
+def _mp_step_recv_kernel(*refs, nx, P, modes, lam, dt, dx, dy, dz,
+                         handoff=False):
     """Multi-plane form of `_plane_step_recv_kernel`: P output planes per
     program from a double-buffered (P+2)-plane T window (`_window_pipeline`
     — the same HBM-traffic win as `_mp_kernel`), each delivered its
@@ -330,7 +331,10 @@ def _mp_step_recv_kernel(*refs, nx, P, modes, lam, dt, dx, dy, dz):
     scratch = refs[-2]
     sems = refs[-1]
 
-    win, l0 = _window_pipeline(T_hbm, scratch, sems, nx=nx, B=P)
+    if handoff:   # static: VMEM overlap handoff, 1.0x T reads
+        win, l0 = _window_pipeline_handoff(T_hbm, scratch, sems, nx=nx, B=P)
+    else:
+        win, l0 = _window_pipeline(T_hbm, scratch, sems, nx=nx, B=P)
     g0 = pl.program_id(0) * P
 
     ny, nz = out_ref.shape[1:]
@@ -431,6 +435,7 @@ def diffusion3d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
 
     if mp:
         kernel = partial(_mp_step_recv_kernel, nx=nx, P=P,
+                         handoff=mp_handoff(T, interpret=interpret),
                          modes=tuple(bool(m) for m in modes), **consts)
         return pl.pallas_call(
             kernel,
@@ -639,6 +644,112 @@ def _window_pipeline(T_hbm, scratch, sems, *, nx, B):
     return win, i * B - wstart(i)
 
 
+def _window_pipeline_handoff(ref, scratch, sems, *, nx, B):
+    """`_window_pipeline` with a VMEM HANDOFF of the window overlap:
+    program i copies the 2-3 overlap planes from the tail of ITS window
+    into the head of the next window's slot and prefetches only the NEW
+    planes from HBM — total T reads become exactly ``nx`` planes (1.0x)
+    instead of the plain pipeline's (1+2/P)x re-read.
+
+    Overlap bookkeeping (windows ``[clip(g*B-1, 0, nx-(B+2)), +B+2)``,
+    ``nx % B == 0``, ``m = nx//B`` programs): the clamp at both global
+    edges makes the overlap 3 planes into windows 1 and m-1 and 2 planes
+    into every interior window; with m == 2 it would be 4 (callers use the
+    plain pipeline there). Total fetched = (B+2) + 2(B-1) + (m-3)B = mB =
+    nx exactly.
+
+    The prefetch DMA (head-disjoint) still starts BEFORE this window's
+    wait, so next-window HBM reads ride under this window's compute; the
+    handoff copy runs after the wait (its source must be complete) as
+    plane-aligned direct stores (an async VMEM->VMEM DMA form tripped an
+    XLA CPU fusion codegen crash in interpret mode), and the sequential
+    grid guarantees it lands before program i+1 reads it. Requires m >= 3
+    and the same in-order execution as the plain pipeline."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    i = pl.program_id(0)
+    m = pl.num_programs(0)
+    S = B + 2
+
+    def wstart(g):
+        return jnp.clip(g * B - 1, 0, nx - S)
+
+    def full_dma(slot, g):
+        return pltpu.make_async_copy(
+            ref.at[pl.ds(wstart(g), S)], scratch.at[slot], sems.at[slot])
+
+    def partial_dma(slot, g, o):  # fetch the S-o NEW planes (o static)
+        return pltpu.make_async_copy(
+            ref.at[pl.ds(wstart(g) + o, S - o)],
+            scratch.at[slot, pl.ds(o, S - o)], sems.at[slot])
+
+    cur, nxt = i % 2, (i + 1) % 2
+    edge_next = (i + 1 == 1) | (i + 1 == m - 1)
+    edge_cur = (i == 1) | (i == m - 1)
+
+    @pl.when(i == 0)
+    def _():
+        full_dma(0, 0).start()
+
+    # prefetch next window's NEW planes (disjoint from its handoff head)
+    @pl.when((i + 1 < m) & edge_next)
+    def _():
+        partial_dma(nxt, i + 1, 3).start()
+
+    @pl.when((i + 1 < m) & ~edge_next)
+    def _():
+        partial_dma(nxt, i + 1, 2).start()
+
+    # wait on OUR window (descriptor must match the copy that filled it)
+    @pl.when(i == 0)
+    def _():
+        full_dma(0, 0).wait()
+
+    @pl.when((i > 0) & edge_cur)
+    def _():
+        partial_dma(cur, i, 3).wait()
+
+    @pl.when((i > 0) & ~edge_cur)
+    def _():
+        partial_dma(cur, i, 2).wait()
+
+    # hand the overlap planes to the next window in VMEM (direct stores:
+    # plane-aligned, static sizes)
+    @pl.when((i + 1 < m) & edge_next)
+    def _():
+        scratch[nxt, pl.ds(0, 3)] = scratch[cur, pl.ds(S - 3, 3)]
+
+    @pl.when((i + 1 < m) & ~edge_next)
+    def _():
+        scratch[nxt, pl.ds(0, 2)] = scratch[cur, pl.ds(S - 2, 2)]
+
+    return scratch.at[cur], i * B - wstart(i)
+
+
+def mp_handoff(T, interpret=False) -> bool:
+    """Whether the multi-plane kernel uses the VMEM window handoff (1.0x T
+    reads) for this shape: needs >= 3 windows; `IGG_MP_HANDOFF=0` forces
+    the plain (1+2/P)x pipeline for A/B measurement."""
+    import os
+
+    P = mp_planes(T, interpret=interpret)
+    if P is None or T.shape[0] // P < 3:
+        return False
+    return os.environ.get("IGG_MP_HANDOFF", "1") != "0"
+
+
+def mp_bytes_per_cell(T, interpret=False):
+    """Traffic model of the multi-plane kernel for this shape (bench.py's
+    roofline accounting): T reads 1.0x with the window handoff else
+    (1+2/P)x, + Cp read 1x + T write 1x, in storage itemsize."""
+    P = mp_planes(T, interpret=interpret)
+    t_reads = 1.0 if mp_handoff(T, interpret=interpret) \
+        else (1.0 + 2.0 / P if P else 3.0)
+    return (t_reads + 2.0) * T.dtype.itemsize
+
+
 def _sequential_grid_params(interpret):
     """pallas_call kwargs forcing in-order grid execution (required by the
     cross-program DMA handoff of `_window_pipeline`)."""
@@ -651,7 +762,7 @@ def _sequential_grid_params(interpret):
 
 
 def _mp_kernel(T_hbm, Cp_ref, out_ref, scratch, sems, *,
-               lam, dt, dx, dy, dz, nx, P, fuse):
+               lam, dt, dx, dy, dz, nx, P, fuse, handoff=False):
     """Compute P output planes from a (P+2)-plane VMEM window of T.
 
     The window is DMA'd once per program, so interior T planes are read
@@ -672,7 +783,10 @@ def _mp_kernel(T_hbm, Cp_ref, out_ref, scratch, sems, *,
     from jax.experimental import pallas as pl
 
     fuse_x, fuse_y, fuse_z = fuse
-    win, l0 = _window_pipeline(T_hbm, scratch, sems, nx=nx, B=P)
+    if handoff:   # static: VMEM overlap handoff, 1.0x T reads
+        win, l0 = _window_pipeline_handoff(T_hbm, scratch, sems, nx=nx, B=P)
+    else:
+        win, l0 = _window_pipeline(T_hbm, scratch, sems, nx=nx, B=P)
     g0 = pl.program_id(0) * P                    # first output plane
 
     ny, nz = out_ref.shape[1:]
@@ -715,7 +829,8 @@ def diffusion3d_step_halo_pallas_mp(T, Cp, *, lam, dt, dx, dy, dz, fuse,
     blk = (P, ny, nz)
     dtp = _const_dtype(T.dtype)
     consts = dict(lam=dtp(lam), dt=dtp(dt), dx=dtp(dx), dy=dtp(dy), dz=dtp(dz))
-    kernel = partial(_mp_kernel, nx=nx, P=P,
+    handoff = mp_handoff(T, interpret=interpret)
+    kernel = partial(_mp_kernel, nx=nx, P=P, handoff=handoff,
                      fuse=tuple(bool(f) for f in fuse), **consts)
 
     try:

@@ -89,6 +89,71 @@ def test_pallas_fused_halo_matches_xla(dims, periods, nx):
     assert np.allclose(a, b, rtol=1e-5, atol=1e-4)
 
 
+def test_mp_window_handoff_selection_and_equivalence(monkeypatch):
+    """The VMEM window handoff (1.0x T reads) engages only with >= 3
+    windows, honors IGG_MP_HANDOFF=0, and changes the traffic model —
+    while the kernel output stays identical to the plain pipeline and the
+    XLA reference over a multi-step run (nx=12, P=4 -> 3 windows)."""
+    import jax
+
+    from implicitglobalgrid_tpu.ops.pallas_stencil import (
+        mp_bytes_per_cell, mp_handoff, mp_planes,
+    )
+
+    monkeypatch.delenv("IGG_MP_HANDOFF", raising=False)
+    s12 = jax.ShapeDtypeStruct((12, 16, 16), np.float32)
+    s8 = jax.ShapeDtypeStruct((8, 16, 16), np.float32)
+    assert mp_planes(s12, interpret=True) == 4
+    assert mp_handoff(s12, interpret=True)          # 3 windows
+    assert not mp_handoff(s8, interpret=True)       # 2 windows: plain
+    assert mp_bytes_per_cell(s12, interpret=True) == 3.0 * 4
+    monkeypatch.setenv("IGG_MP_HANDOFF", "0")
+    assert not mp_handoff(s12, interpret=True)
+    assert mp_bytes_per_cell(s12, interpret=True) == (3.0 + 2.0 / 4) * 4
+    monkeypatch.delenv("IGG_MP_HANDOFF")
+
+    igg.init_global_grid(12, 16, 16, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    T, Cp, p = init_diffusion3d(dtype=np.float32)
+    a = np.asarray(igg.gather(make_run(p, 10, impl="xla")(T, Cp)[0]))
+    b = np.asarray(igg.gather(
+        make_run(p, 10, impl="pallas_interpret")(T, Cp)[0]))
+    assert np.allclose(a, b, rtol=1e-5, atol=1e-4)
+    # plain pipeline (flag off) produces the SAME kernel output
+    monkeypatch.setenv("IGG_MP_HANDOFF", "0")
+    igg.finalize_global_grid()
+    igg.init_global_grid(12, 16, 16, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    T, Cp, p = init_diffusion3d(dtype=np.float32)
+    c = np.asarray(igg.gather(
+        make_run(p, 10, impl="pallas_interpret")(T, Cp)[0]))
+    assert np.array_equal(b, c)
+
+
+def test_mp_handoff_multishard_matches_xla(monkeypatch):
+    """The handoff window inside the multi-shard fused step+exchange
+    kernel (`_mp_step_recv_kernel`, local nx=12 -> 3 windows): 10-step
+    whole-loop equality with the XLA step + sequential exchange."""
+    import jax
+
+    from implicitglobalgrid_tpu.ops.pallas_stencil import (
+        mp_handoff, step_exchange_modes,
+    )
+
+    monkeypatch.delenv("IGG_MP_HANDOFF", raising=False)
+    igg.init_global_grid(12, 12, 16, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    gg = igg.global_grid()
+    sds = jax.ShapeDtypeStruct((12, 12, 16), np.float32)
+    assert mp_handoff(sds, interpret=True)
+    assert step_exchange_modes(gg, sds) == (True, True, True)
+    T, Cp, p = init_diffusion3d(dtype=np.float32)
+    a = np.asarray(igg.gather(make_run(p, 10, impl="xla")(T, Cp)[0]))
+    b = np.asarray(igg.gather(
+        make_run(p, 10, impl="pallas_interpret")(T, Cp)[0]))
+    assert np.allclose(a, b, rtol=1e-5, atol=1e-4)
+
+
 def test_impl_resolution_from_env_flag():
     from implicitglobalgrid_tpu.models.diffusion import _resolve_impl
 
