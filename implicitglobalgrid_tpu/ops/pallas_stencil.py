@@ -728,16 +728,25 @@ def _window_pipeline_handoff(ref, scratch, sems, *, nx, B):
     return scratch.at[cur], i * B - wstart(i)
 
 
+def window_handoff_enabled() -> bool:
+    """`IGG_MP_HANDOFF=0` forces the plain re-reading window pipeline in
+    every kernel family (A/B measurement)."""
+    import os
+
+    return os.environ.get("IGG_MP_HANDOFF", "1") != "0"
+
+
+def handoff_ok(nx, P) -> bool:
+    """The shared window-handoff gate for every kernel family: >= 3
+    windows (the 2-window case has a 4-plane overlap) and the env flag."""
+    return P is not None and nx // P >= 3 and window_handoff_enabled()
+
+
 def mp_handoff(T, interpret=False) -> bool:
     """Whether the multi-plane kernel uses the VMEM window handoff (1.0x T
     reads) for this shape: needs >= 3 windows; `IGG_MP_HANDOFF=0` forces
     the plain (1+2/P)x pipeline for A/B measurement."""
-    import os
-
-    P = mp_planes(T, interpret=interpret)
-    if P is None or T.shape[0] // P < 3:
-        return False
-    return os.environ.get("IGG_MP_HANDOFF", "1") != "0"
+    return handoff_ok(int(T.shape[0]), mp_planes(T, interpret=interpret))
 
 
 def mp_bytes_per_cell(T, interpret=False):

@@ -289,15 +289,18 @@ def wave_mp_planes(p_shape, dtype, interpret=False):
 
 
 def _wave_mp_kernel(*refs, nx, P, modes, cx, cy, cz, dtK, dx, dy, dz,
-                    self_ols=None):
+                    self_ols=None, handoff=False):
     """Multi-plane form: P output planes per program; the pressure planes
     come from a double-buffered (P+2)-window and the Vx faces from a
     (P+1)-window (faces g0..g0+P — exact, no clamping), cutting their HBM
-    reads from 3x/2x to (1+2/P)x/(1+1/P)x."""
+    reads from 3x/2x to (1+2/P)x/(1+1/P)x — and to 1.0x pressure reads
+    with the VMEM window handoff (`handoff`, >= 3 windows)."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    from .pallas_stencil import _window_pipeline, _window_pipeline_general
+    from .pallas_stencil import (
+        _window_pipeline, _window_pipeline_general, _window_pipeline_handoff,
+    )
 
     it = iter(refs)
     P_hbm = next(it)
@@ -323,7 +326,11 @@ def _wave_mp_kernel(*refs, nx, P, modes, cx, cy, cz, dtK, dx, dy, dz,
     p_scr, vx_scr, p_sems, vx_sems = refs[-4:]
 
     g0 = pl.program_id(0) * P
-    p_win, l0 = _window_pipeline(P_hbm, p_scr, p_sems, nx=nx, B=P)
+    if handoff:   # static: VMEM overlap handoff, 1.0x pressure reads
+        p_win, l0 = _window_pipeline_handoff(P_hbm, p_scr, p_sems,
+                                             nx=nx, B=P)
+    else:
+        p_win, l0 = _window_pipeline(P_hbm, p_scr, p_sems, nx=nx, B=P)
     vx_win = _window_pipeline_general(
         Vx_hbm, vx_scr, vx_sems, size=P + 1, start_fn=lambda g: g * P)
 
@@ -466,9 +473,12 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
 
         from .pallas_stencil import _sequential_grid_params
 
+        from .pallas_stencil import handoff_ok
+
         kernel = partial(_wave_mp_kernel, nx=nx, P=Pmp, modes=kmod,
                          cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dxp, dy=dyp,
-                         dz=dzp, self_ols=self_ols)
+                         dz=dzp, self_ols=self_ols,
+                         handoff=handoff_ok(nx, Pmp))
         Pn, Vxn, Vyn, Vzn = pl.pallas_call(
             kernel,
             grid=(nx // Pmp,),

@@ -124,6 +124,24 @@ def test_acoustic_pallas_fused_matches_xla(dims, periods, label):
         assert np.allclose(ga, gb, rtol=1e-5, atol=1e-5), (label, name)
 
 
+def test_acoustic_pallas_window_handoff_matches_xla(monkeypatch):
+    """The acoustic pressure window with the VMEM overlap handoff
+    (local nx=12, P=4 -> 3 windows): fused pass equality vs the XLA
+    formulation."""
+    monkeypatch.delenv("IGG_MP_HANDOFF", raising=False)
+    from implicitglobalgrid_tpu.ops.pallas_wave import wave_mp_planes
+
+    igg.init_global_grid(12, 8, 16, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    assert wave_mp_planes((12, 8, 16), np.float32, interpret=True) == 4
+    state, p = init_acoustic3d(dtype=np.float32)
+    a = run_acoustic(state, p, 6, nt_chunk=3, impl="xla")
+    b = run_acoustic(state, p, 6, nt_chunk=3, impl="pallas_interpret")
+    for fa, fb, name in zip(a, b, ("P", "Vx", "Vy", "Vz")):
+        ga, gb = np.asarray(igg.gather(fa)), np.asarray(igg.gather(fb))
+        assert np.allclose(ga, gb, rtol=1e-5, atol=1e-5), name
+
+
 @pytest.mark.parametrize("dims,periods,label", [
     ((1, 1, 1), (1, 1, 1), "all self-neighbor"),
     ((2, 2, 2), (0, 0, 0), "all multi-shard PROC_NULL edges"),
