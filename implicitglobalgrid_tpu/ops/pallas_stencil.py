@@ -759,6 +759,55 @@ def mp_bytes_per_cell(T, interpret=False):
     return (t_reads + 2.0) * T.dtype.itemsize
 
 
+def _window_pipeline_aligned_handoff(ref, scratch, sems, *, size, B):
+    """Handoff form of the ALIGNED window ``[g*B, g*B+size)`` (uniform
+    overlap ``o = size - B``, no clamping — e.g. the acoustic Vx face
+    window, size=P+1): program i hands the o overlap planes across in
+    VMEM and prefetches only the B new planes. Total fetch = size +
+    (m-1)*B = nx + o exactly. Works for any m >= 2 (the overlap is
+    uniform, unlike the clamped `_window_pipeline_handoff`). Same
+    sequential-grid contract as the other pipelines."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    i = pl.program_id(0)
+    m = pl.num_programs(0)
+    o = size - B
+
+    def full_dma(slot, g):
+        return pltpu.make_async_copy(
+            ref.at[pl.ds(g * B, size)], scratch.at[slot], sems.at[slot])
+
+    def partial_dma(slot, g):
+        return pltpu.make_async_copy(
+            ref.at[pl.ds(g * B + o, B)],
+            scratch.at[slot, pl.ds(o, B)], sems.at[slot])
+
+    cur, nxt = i % 2, (i + 1) % 2
+
+    @pl.when(i == 0)
+    def _():
+        full_dma(0, 0).start()
+
+    @pl.when(i + 1 < m)
+    def _():
+        partial_dma(nxt, i + 1).start()
+
+    @pl.when(i == 0)
+    def _():
+        full_dma(0, 0).wait()
+
+    @pl.when(i > 0)
+    def _():
+        partial_dma(cur, i).wait()
+
+    @pl.when(i + 1 < m)
+    def _():
+        scratch[nxt, pl.ds(0, o)] = scratch[cur, pl.ds(size - o, o)]
+
+    return scratch.at[cur]
+
+
 def _sequential_grid_params(interpret):
     """pallas_call kwargs forcing in-order grid execution (required by the
     cross-program DMA handoff of `_window_pipeline`)."""

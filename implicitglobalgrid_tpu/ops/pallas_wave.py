@@ -299,7 +299,8 @@ def _wave_mp_kernel(*refs, nx, P, modes, cx, cy, cz, dtK, dx, dy, dz,
     from jax.experimental import pallas as pl
 
     from .pallas_stencil import (
-        _window_pipeline, _window_pipeline_general, _window_pipeline_handoff,
+        _window_pipeline, _window_pipeline_aligned_handoff,
+        _window_pipeline_general, _window_pipeline_handoff,
     )
 
     it = iter(refs)
@@ -326,13 +327,17 @@ def _wave_mp_kernel(*refs, nx, P, modes, cx, cy, cz, dtK, dx, dy, dz,
     p_scr, vx_scr, p_sems, vx_sems = refs[-4:]
 
     g0 = pl.program_id(0) * P
-    if handoff:   # static: VMEM overlap handoff, 1.0x pressure reads
+    if handoff:   # static: VMEM overlap handoff — 1.0x pressure reads and
+        # (nx+1)-plane total Vx fetches (the aligned window's uniform
+        # 1-plane overlap is handed across instead of re-read)
         p_win, l0 = _window_pipeline_handoff(P_hbm, p_scr, p_sems,
                                              nx=nx, B=P)
+        vx_win = _window_pipeline_aligned_handoff(
+            Vx_hbm, vx_scr, vx_sems, size=P + 1, B=P)
     else:
         p_win, l0 = _window_pipeline(P_hbm, p_scr, p_sems, nx=nx, B=P)
-    vx_win = _window_pipeline_general(
-        Vx_hbm, vx_scr, vx_sems, size=P + 1, start_fn=lambda g: g * P)
+        vx_win = _window_pipeline_general(
+            Vx_hbm, vx_scr, vx_sems, size=P + 1, start_fn=lambda g: g * P)
 
     def per_plane(field, k, j):
         r = got[field][k]
@@ -471,9 +476,7 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
     if mp:
         from jax.experimental.pallas import tpu as pltpu
 
-        from .pallas_stencil import _sequential_grid_params
-
-        from .pallas_stencil import handoff_ok
+        from .pallas_stencil import _sequential_grid_params, handoff_ok
 
         kernel = partial(_wave_mp_kernel, nx=nx, P=Pmp, modes=kmod,
                          cx=cx, cy=cy, cz=cz, dtK=dtK, dx=dxp, dy=dyp,
