@@ -224,41 +224,8 @@ def main() -> None:
     # can be computed against measured reality instead of only the nominal
     # datasheet peak (round-3 verdict: the headline exceeded the nominal
     # roofline; nominal clocks and DMA efficiency are not ground truth).
-    def _triad_gbps():
-        import jax.numpy as jnp
-
-        n = (1 << 20) if cpu else (1 << 27)  # 512 MB f32 on TPU
-        a = jnp.arange(n, dtype=jnp.float32)
-        b = jnp.ones((n,), jnp.float32)
-
-        import jax as _jax
-
-        @_jax.jit
-        def triad_chunk(a, b, c):
-            # carry keeps b in place (no buffer swap -> no hidden
-            # while-loop carry copy; see docs/performance.md trace notes)
-            def body(_, ab):
-                a, b = ab
-                return (b * 1.0001 + a * 0.5, b)
-            return _jax.lax.fori_loop(0, c, body, (a, b))
-
-        def chunk(c):
-            r = triad_chunk(a, b, c)
-            _jax.block_until_ready(r)
-
-        # no grid here: igg.tic/toc (two_point's default timer) needs one;
-        # plain wall clock is fine since chunk() drains its own outputs
-        import time as _time
-
-        def timer(fn):
-            t0 = _time.perf_counter()
-            fn()
-            return _time.perf_counter() - t0
-
-        s = two_point(chunk, 4, 12, timer=timer)
-        return 3 * 4 * n / s / 1e9
-
-    part("hbm_triad_GBps", _triad_gbps)
+    part("hbm_triad_GBps", lambda: bench_util.measure_triad_gbps(
+        (1 << 20) if cpu else (1 << 27)))  # 512 MB f32 on TPU
 
     # --- update_halo effective GB/s (BASELINE's first named metric) --------
     def _halo_gbps():

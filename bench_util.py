@@ -247,6 +247,41 @@ def is_child() -> bool:
     return bool(sep) and len(token) >= 8 and pid == str(os.getppid())
 
 
+def measure_triad_gbps(n: int, c1: int = 4) -> float:
+    """Fused-XLA triad bandwidth (2 reads + 1 write over ``n`` f32
+    elements): the practical HBM ceiling used for roofline percentages.
+    Shared by `bench.py` (in-run calibration) and `bench_membw.py` — the
+    loop carry keeps ``b`` in place, because a swapped carry pins
+    while-loop buffers and pays a hidden full-array copy per step (see
+    docs/performance.md trace notes). Grid-independent (wall-clock timer;
+    the chunk drains its own outputs)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    a = jnp.arange(n, dtype=jnp.float32)
+    b = jnp.ones((n,), jnp.float32)
+
+    @jax.jit
+    def triad_chunk(a, b, c):
+        def body(_, ab):
+            a, b = ab
+            return (b * 1.0001 + a * 0.5, b)
+        return jax.lax.fori_loop(0, c, body, (a, b))
+
+    def chunk(c):
+        jax.block_until_ready(triad_chunk(a, b, c))
+
+    def timer(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    s = two_point(chunk, c1, 3 * c1, timer=timer)
+    return 3 * 4 * n / s / 1e9
+
+
 def two_point(run_chunk, c1: int, c2: int, reps: int = 2,
               timer=None) -> float:
     """Steady-state seconds/step via two warmed one-call chunk windows.

@@ -5,7 +5,7 @@
 # a killed TPU-attached process wedges the chip claim for hours.
 #
 #   bash capture_tpu_evidence.sh && git add BENCH_TPU.json \
-#       BENCH_HALO_TPU.json BENCH_PALLAS_TPU.json && git commit
+#       BENCH_HALO_TPU.json BENCH_PALLAS_TPU.json MEMBW_TPU.json && git commit
 #
 # Each artifact is the bench's JSON line(s), tagged with platform/
 # device_kind by bench_util.emit; rows with "platform": "cpu" or a
@@ -22,10 +22,13 @@ python bench_halo.py | tee BENCH_HALO_TPU.json
 echo "== bench_pallas_check.py (kernel-vs-XLA equality on hardware)"
 python bench_pallas_check.py | tee BENCH_PALLAS_TPU.json
 
+echo "== bench_membw.py (HBM microbenchmarks behind docs/performance.md)"
+python bench_membw.py | tee MEMBW_TPU.json
+
 echo "== done; every row's platform tag (null/cpu/fallback rows => do NOT commit):"
 grep -h -o '"platform": [^,]*' BENCH_TPU.json BENCH_HALO_TPU.json \
-    BENCH_PALLAS_TPU.json | sort | uniq -c
+    BENCH_PALLAS_TPU.json MEMBW_TPU.json | sort | uniq -c
 if grep -l '"fallback"' BENCH_TPU.json BENCH_HALO_TPU.json \
-        BENCH_PALLAS_TPU.json; then
+        BENCH_PALLAS_TPU.json MEMBW_TPU.json; then
     echo "WARNING: a fallback tag is present — tunnel dropped mid-capture"
 fi
