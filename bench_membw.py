@@ -110,24 +110,47 @@ def main() -> None:
          lambda c: jax.block_until_ready(copy_loop(A, c)), 2 * nbytes,
          "BlockSpec-pipelined read+write pass — the VMEM copy bound")
 
-    # --- dim-2 (lane-edge) in-place RMW ----------------------------------
-    # array-traffic convention: the touched lane tiles (2 x 512-lane tile
-    # columns of the array) read+written in place
-    lane_tile = 128 if not cpu else min(128, n)
-    slab = jnp.zeros((n, n, 1), np.float32)
+    # --- dim-2 (lane-edge) strided tile RMW ------------------------------
+    # The HYPOTHETICAL dim-2 delivery the framework deliberately does NOT
+    # use (pallas_halo has no dim-2 kernel — this access pattern measured
+    # slower than the combined one-pass unpack; docs/performance.md).
+    # Measurement-only kernel: per x-plane, read+write the two edge
+    # lane-tile columns in place, replacing the halo lane.
+    from jax import lax
+
+    lane_tile = min(128, n // 2)  # n//2 keeps the two edge tiles distinct
+    n_lt = n // lane_tile
+
+    def rmw_kernel(x_ref, o_ref):
+        s = pl.program_id(1)
+        row = x_ref[0]                          # (n, lane_tile)
+        col = lax.broadcasted_iota(jnp.int32, row.shape, 1)
+        halo_lane = jnp.where(s == 0, 0, lane_tile - 1)
+        o_ref[0] = jnp.where(col == halo_lane, jnp.float32(0.123), row)
+
+    def rmw_once(x):
+        edge = pl.BlockSpec((1, n, lane_tile),
+                            lambda i, s: (i, 0, s * (n_lt - 1)))
+        return pl.pallas_call(
+            rmw_kernel,
+            grid=(n, 2),
+            in_specs=[edge],
+            out_specs=edge,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            input_output_aliases={0: 0},
+            interpret=interpret,
+        )(x)
 
     @jax.jit
     def rmw_loop(a, c):
-        def body(_, x):
-            return ph.halo_write_inplace(x, slab, slab, dim=2, hw=1,
-                                         interpret=interpret)
-        return jax.lax.fori_loop(0, c, body, a)
+        return jax.lax.fori_loop(0, c, lambda _, x: rmw_once(x), a)
 
     tile_bytes = 2 * (n * n * lane_tile * 4) * 2    # 2 sides, R+W
     rate("edge_rmw_GBps",
          lambda c: jax.block_until_ready(rmw_loop(A, c)), tile_bytes,
-         f"in-place dim-2 halo write; traffic = 2 edge {lane_tile}-lane "
-         "tile columns R+W")
+         f"strided in-place RMW of the 2 edge {lane_tile}-lane tile "
+         "columns (the dim-2 delivery alternative the combined one-pass "
+         "kernel replaces)")
 
     # --- combined one-pass unpack (all six slabs) ------------------------
     recvs = {

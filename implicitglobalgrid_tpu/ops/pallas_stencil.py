@@ -979,7 +979,8 @@ def strip_rows_2d(T, interpret=False):
     return None
 
 
-def _strip2d_kernel(*refs, nx, R, H, modes, lam, dt, dx, dy):
+def _strip2d_kernel(*refs, nx, R, H, modes, lam, dt, dx, dy,
+                    handoff=False):
     """Compute R output rows from a manually DMA'd VMEM strip of T, then
     deliver the received halo slabs: x whole rows first, then y lanes — the
     exchange order for 2-D blocks (dims 0 then 1 of the z, x, y default;
@@ -1011,38 +1012,55 @@ def _strip2d_kernel(*refs, nx, R, H, modes, lam, dt, dx, dy):
     i = pl.program_id(0)
     nprog = pl.num_programs(0)
 
-    def dmas(slot, g):
+    def ds(start, size):
         # every start is a multiple of the H-row tile by construction
         # (R % H == 0, nx % H == 0 — `strip_rows_2d`); Mosaic needs the
         # explicit hint to slice the row-tiled 2-D memref at a traced index
-        def ds(start, size):
-            return pl.ds(pl.multiple_of(start, H), size)
+        return pl.ds(pl.multiple_of(start, H), size)
 
-        return (
-            pltpu.make_async_copy(
-                T_hbm.at[ds(g * R, R)], body_scr.at[slot],
-                sems.at[slot, 0]),
-            pltpu.make_async_copy(
-                T_hbm.at[ds(jnp.maximum(g * R - H, 0), H)],
-                above_scr.at[slot], sems.at[slot, 1]),
-            pltpu.make_async_copy(
-                T_hbm.at[ds(jnp.minimum(g * R + R, nx - H), H)],
-                below_scr.at[slot], sems.at[slot, 2]),
-        )
+    def body_dma(slot, g):
+        return pltpu.make_async_copy(
+            T_hbm.at[ds(g * R, R)], body_scr.at[slot], sems.at[slot, 0])
+
+    def above_dma(slot, g):
+        return pltpu.make_async_copy(
+            T_hbm.at[ds(jnp.maximum(g * R - H, 0), H)],
+            above_scr.at[slot], sems.at[slot, 1])
+
+    def below_dma(slot, g):
+        return pltpu.make_async_copy(
+            T_hbm.at[ds(jnp.minimum(g * R + R, nx - H), H)],
+            below_scr.at[slot], sems.at[slot, 2])
 
     @pl.when(i == 0)
     def _():
-        for d in dmas(0, 0):
-            d.start()
+        body_dma(0, 0).start()
+        below_dma(0, 0).start()
+        above_dma(0, 0).start()
 
     @pl.when(i + 1 < nprog)
     def _():
-        for d in dmas((i + 1) % 2, i + 1):
-            d.start()
+        body_dma((i + 1) % 2, i + 1).start()
+        below_dma((i + 1) % 2, i + 1).start()
+        if not handoff:
+            above_dma((i + 1) % 2, i + 1).start()
 
     slot = i % 2
-    for d in dmas(slot, i):
-        d.wait()
+    body_dma(slot, i).wait()
+    below_dma(slot, i).wait()
+    if handoff:
+        # the above tile for g >= 1 is the tail of the PREVIOUS body —
+        # handed across in VMEM by the previous program (below); only
+        # program 0 fetched its (edge-clamped) above tile from HBM
+        @pl.when(i == 0)
+        def _():
+            above_dma(0, 0).wait()
+
+        @pl.when(i + 1 < nprog)
+        def _():
+            above_scr[(i + 1) % 2] = body_scr[slot][R - H:, :]
+    else:
+        above_dma(slot, i).wait()
 
     g0 = i * R
     tc = body_scr[slot]                                        # (R, ny)
@@ -1115,6 +1133,10 @@ def diffusion2d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
 
     H = _sublane_tile(T.dtype)
     kernel = partial(_strip2d_kernel, nx=nx, R=R, H=H,
+                     # above-tile handoff: the overlap is uniform and
+                     # `strip_rows_2d` guarantees >= 2 strips, so only
+                     # the env flag gates it
+                     handoff=window_handoff_enabled(),
                      modes=tuple(bool(m) for m in modes), **consts)
     kwargs = _sequential_grid_params(interpret)
     return pl.pallas_call(
