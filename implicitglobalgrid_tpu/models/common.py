@@ -74,13 +74,14 @@ def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
         unroll = 4 if gg.device_type == "tpu" else 1
     unroll = max(1, min(int(unroll), int(nt_chunk)))
     if key is not None:
-        # window_handoff_enabled is read at TRACE time inside the kernel
-        # builders; keying on it keeps the documented IGG_MP_HANDOFF A/B
-        # flip honest within one grid epoch (no stale cached runner).
-        from ..ops.pallas_stencil import window_handoff_enabled
+        # kernel_flags are read at TRACE time inside the kernel builders;
+        # keying on them keeps the documented IGG_MP_HANDOFF /
+        # IGG_PLANE_RELAY A/B flips honest within one grid epoch (no
+        # stale cached runner).
+        from ..ops.pallas_stencil import kernel_flags
 
         full_key = (gg.epoch, key, tuple(state_ndims), int(nt_chunk),
-                    bool(check_vma), int(unroll), window_handoff_enabled())
+                    bool(check_vma), int(unroll), kernel_flags())
         fn = _runner_cache.get(full_key)
         if fn is not None:
             return fn

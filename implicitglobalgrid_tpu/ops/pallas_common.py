@@ -22,6 +22,25 @@ AXIS_OF = {"x": 0, "y": 1, "z": 2}
 # intermediate at full plane size with offset-0 layouts, cloning the edge
 # row/lane (callers mask the garbage edge through their interior masks).
 
+def plane_relay(rel_ref, i, cur):
+    """The previous grid program's plane (``cur`` itself at i == 0,
+    matching the edge-clamped ``[max(i-1, 0)]`` stream it replaces), while
+    storing ``cur`` for the next program: one HBM input stream per field
+    becomes a VMEM relay across the IN-ORDER grid ("arbitrary" dimension
+    semantics required). ``rel_ref``: VMEM ``(2, *plane)`` scratch.
+    Alignment-free — works for staggered (ny+1 / nz+1) planes where the
+    manual window DMA cannot (`window_dma_ok`)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    prev = rel_ref[(i + 1) % 2]
+    # vector mask (scalar-predicate selects are Mosaic-fragile)
+    row = lax.broadcasted_iota(jnp.int32, cur.shape, 0)
+    out = jnp.where((row >= 0) & (i > 0), prev, cur)
+    rel_ref[i % 2] = cur
+    return out
+
+
 def shift_up(a):
     """out[r] = a[r+1]; last row clones a[-1] (garbage — mask it)."""
     import jax.numpy as jnp

@@ -736,6 +736,20 @@ def window_handoff_enabled() -> bool:
     return os.environ.get("IGG_MP_HANDOFF", "1") != "0"
 
 
+def plane_relay_enabled() -> bool:
+    """`IGG_PLANE_RELAY=0` restores the HBM ``[i-1]`` input streams in the
+    plane-per-program kernels (A/B measurement / Mosaic escape hatch)."""
+    import os
+
+    return os.environ.get("IGG_PLANE_RELAY", "1") != "0"
+
+
+def kernel_flags() -> tuple:
+    """Trace-time kernel-variant flags — part of every runner cache key so
+    flipping either env var retraces instead of replaying stale kernels."""
+    return (window_handoff_enabled(), plane_relay_enabled())
+
+
 def handoff_ok(nx, P) -> bool:
     """The shared window-handoff gate for every kernel family: >= 3
     windows (the 2-window case has a 4-plane overlap) and the env flag."""

@@ -128,7 +128,7 @@ from .pallas_common import recv_kinds as _stokes_recv_kinds
 
 
 def _stokes_kernel(*refs, nx, modes, mu, dt_v, dt_p, damp, dx, dy, dz,
-                   self_ols=None):
+                   self_ols=None, relay=True):
     """One x-plane of the fused PT iteration. Arithmetic mirrors
     `models.stokes._stokes_terms` term-for-term (same accumulation order)
     restricted to this plane; then the interior-masked dV/V updates and the
@@ -150,10 +150,17 @@ def _stokes_kernel(*refs, nx, modes, mu, dt_v, dt_p, damp, dx, dy, dz,
     from .pallas_common import shift_down, shift_left, shift_right, shift_up
 
     it = iter(refs)
-    p_m, p_c = (next(it)[0] for _ in range(2))
-    vxm, vxc, vxp = (next(it)[0] for _ in range(3))
-    vym, vyc, vyp = (next(it)[0] for _ in range(3))
-    vzm, vzc, vzp = (next(it)[0] for _ in range(3))
+    if relay:
+        # the [i-1] planes arrive by VMEM relay (below), not HBM streams
+        p_c = next(it)[0]
+        vxc, vxp = (next(it)[0] for _ in range(2))
+        vyc, vyp = (next(it)[0] for _ in range(2))
+        vzc, vzp = (next(it)[0] for _ in range(2))
+    else:
+        p_m, p_c = (next(it)[0] for _ in range(2))
+        vxm, vxc, vxp = (next(it)[0] for _ in range(3))
+        vym, vyc, vyp = (next(it)[0] for _ in range(3))
+        vzm, vzc, vzp = (next(it)[0] for _ in range(3))
     dvxc = next(it)[0]
     dvyc = next(it)[0]
     dvzc = next(it)[0]
@@ -166,9 +173,19 @@ def _stokes_kernel(*refs, nx, modes, mu, dt_v, dt_p, damp, dx, dy, dz,
     rVx = take_recvs(it, modes, "Vx", kinds["Vx"])
     rVy = take_recvs(it, modes, "Vy", kinds["Vy"])
     rVz = take_recvs(it, modes, "Vz", kinds["Vz"])
-    oP, oVx, oVy, oVz, odVx, odVy, odVz = refs[-7:]
 
     i = pl.program_id(0)
+    if relay:
+        from .pallas_common import plane_relay
+
+        oP, oVx, oVy, oVz, odVx, odVy, odVz = refs[-11:-4]
+        relP, relVx, relVy, relVz = refs[-4:]
+        p_m = plane_relay(relP, i, p_c)
+        vxm = plane_relay(relVx, i, vxc)
+        vym = plane_relay(relVy, i, vyc)
+        vzm = plane_relay(relVz, i, vzc)
+    else:
+        oP, oVx, oVy, oVz, odVx, odVy, odVz = refs[-7:]
     ny, nz = p_c.shape
 
     def d_y(a):  # cell-centred face difference (full size: (ny+1,.) -> (ny,.))
@@ -303,28 +320,49 @@ def stokes_step_exchange_pallas(state, gg, modes, p, *, interpret=False):
     def spec(shape, index_map):
         return pl.BlockSpec(shape, index_map)
 
+    from .pallas_stencil import plane_relay_enabled
+
+    relay = plane_relay_enabled()
     cP = (1, ny, nz)
     cY = (1, ny + 1, nz)
     cZ = (1, ny, nz + 1)
-    operands = [P, P, Vx, Vx, Vx, Vy, Vy, Vy, Vz, Vz, Vz,
-                dVx, dVy, dVz, rhog]
-    in_specs = [
-        spec(cP, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # P[i-1]
-        spec(cP, lambda i: (i, 0, 0)),                        # P[i]
-        spec(cP, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vx[i-1]
-        spec(cP, lambda i: (i, 0, 0)),                        # Vx[i]
-        spec(cP, lambda i: (i + 1, 0, 0)),                    # Vx[i+1]
-        spec(cY, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vy[i-1]
-        spec(cY, lambda i: (i, 0, 0)),                        # Vy[i]
-        spec(cY, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
-        spec(cZ, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vz[i-1]
-        spec(cZ, lambda i: (i, 0, 0)),                        # Vz[i]
-        spec(cZ, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
-        spec(cP, lambda i: (i, 0, 0)),                        # dVx[i]
-        spec(cY, lambda i: (i, 0, 0)),                        # dVy[i]
-        spec(cZ, lambda i: (i, 0, 0)),                        # dVz[i]
-        spec(cP, lambda i: (i, 0, 0)),                        # rhog[i]
-    ]
+    if relay:
+        # [i-1] streams replaced by the in-kernel VMEM relay: 11 HBM input
+        # streams instead of 15
+        operands = [P, Vx, Vx, Vy, Vy, Vz, Vz, dVx, dVy, dVz, rhog]
+        in_specs = [
+            spec(cP, lambda i: (i, 0, 0)),                        # P[i]
+            spec(cP, lambda i: (i, 0, 0)),                        # Vx[i]
+            spec(cP, lambda i: (i + 1, 0, 0)),                    # Vx[i+1]
+            spec(cY, lambda i: (i, 0, 0)),                        # Vy[i]
+            spec(cY, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
+            spec(cZ, lambda i: (i, 0, 0)),                        # Vz[i]
+            spec(cZ, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
+            spec(cP, lambda i: (i, 0, 0)),                        # dVx[i]
+            spec(cY, lambda i: (i, 0, 0)),                        # dVy[i]
+            spec(cZ, lambda i: (i, 0, 0)),                        # dVz[i]
+            spec(cP, lambda i: (i, 0, 0)),                        # rhog[i]
+        ]
+    else:
+        operands = [P, P, Vx, Vx, Vx, Vy, Vy, Vy, Vz, Vz, Vz,
+                    dVx, dVy, dVz, rhog]
+        in_specs = [
+            spec(cP, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # P[i-1]
+            spec(cP, lambda i: (i, 0, 0)),                        # P[i]
+            spec(cP, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vx[i-1]
+            spec(cP, lambda i: (i, 0, 0)),                        # Vx[i]
+            spec(cP, lambda i: (i + 1, 0, 0)),                    # Vx[i+1]
+            spec(cY, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vy[i-1]
+            spec(cY, lambda i: (i, 0, 0)),                        # Vy[i]
+            spec(cY, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
+            spec(cZ, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vz[i-1]
+            spec(cZ, lambda i: (i, 0, 0)),                        # Vz[i]
+            spec(cZ, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
+            spec(cP, lambda i: (i, 0, 0)),                        # dVx[i]
+            spec(cY, lambda i: (i, 0, 0)),                        # dVy[i]
+            spec(cZ, lambda i: (i, 0, 0)),                        # dVz[i]
+            spec(cP, lambda i: (i, 0, 0)),                        # rhog[i]
+        ]
 
     from .pallas_common import add_recv_operands, out_shape_with_vma
 
@@ -351,10 +389,25 @@ def stokes_step_exchange_pallas(state, gg, modes, p, *, interpret=False):
         return out_shape_with_vma(a, operands)
 
     kernel = partial(
-        _stokes_kernel, nx=nx,
+        _stokes_kernel, nx=nx, relay=relay,
         modes={k: tuple(bool(b) for b in v) for k, v in modes.items()},
         mu=dtp(p.mu), dt_v=dtp(p.dt_v), dt_p=dtp(p.dt_p), damp=dtp(p.damp),
         dx=dtp(p.dx), dy=dtp(p.dy), dz=dtp(p.dz), self_ols=self_ols)
+
+    if relay:
+        from jax.experimental.pallas import tpu as pltpu
+
+        from .pallas_stencil import _sequential_grid_params
+
+        extra = dict(
+            scratch_shapes=[pltpu.VMEM((2, ny, nz), P.dtype),
+                            pltpu.VMEM((2, ny, nz), Vx.dtype),
+                            pltpu.VMEM((2, ny + 1, nz), Vy.dtype),
+                            pltpu.VMEM((2, ny, nz + 1), Vz.dtype)],
+            **_sequential_grid_params(interpret),  # relay needs in-order
+        )
+    else:
+        extra = {}
 
     Pn, Vxn, Vyn, Vzn, dVxn, dVyn, dVzn = pl.pallas_call(
         kernel,
@@ -373,6 +426,7 @@ def stokes_step_exchange_pallas(state, gg, modes, p, *, interpret=False):
                    out_shape_of(Vz), out_shape_of(dVx), out_shape_of(dVy),
                    out_shape_of(dVz)],
         interpret=interpret,
+        **extra,
     )(*operands)
 
     # Vx plane nx (the kernel grid covers planes 0..nx-1): delivered like
