@@ -112,10 +112,62 @@ def main() -> None:
         if last is not None and last["method"] != "two-point":
             notes[name + "_method"] = last["method"]
 
+    from contextlib import contextmanager
+
+    _VARIANT_VARS = ("IGG_MP_HANDOFF", "IGG_PLANE_RELAY")
+
+    @contextmanager
+    def _variants_off():
+        """Force the conservative kernel pipelines, RESTORING any
+        user-set values afterwards (an A/B run like IGG_MP_HANDOFF=0
+        must survive an unrelated config failure)."""
+        saved = {v: os.environ.get(v) for v in _VARIANT_VARS}
+        try:
+            for v in _VARIANT_VARS:
+                os.environ[v] = "0"
+            yield
+        finally:
+            for v, old in saved.items():
+                if old is None:
+                    os.environ.pop(v, None)
+                else:
+                    os.environ[v] = old
+
+    def part(name, fn):
+        """Guarded config: a failure first retries with the round-4
+        kernel variants (window handoff / plane relay) disabled — they
+        are Mosaic-unverified on hardware, and a variant rejection must
+        degrade the row, not null it — then records the error."""
+        try:
+            configs[name] = fn()
+            _method_note(name)
+            return
+        except Exception as e:  # pragma: no cover - evidence robustness
+            first_err = repr(e)[-250:]
+            try:
+                if igg.grid_is_initialized():
+                    igg.finalize_global_grid()
+            except Exception:
+                pass
+        try:
+            with _variants_off():
+                configs[name] = fn()
+            _method_note(name)
+            notes[name + "_degraded"] = (
+                "kernel variants disabled after: " + first_err)
+        except Exception as e2:  # pragma: no cover
+            configs[name] = None
+            notes[name] = first_err + " | degraded retry: " + repr(e2)[-250:]
+            try:
+                if igg.grid_is_initialized():
+                    igg.finalize_global_grid()
+            except Exception:
+                pass
+
     # --- headline: diffusion3D f32 (BASELINE config 1) ---------------------
     nx, nt = (64, 10) if cpu else (256, 600)
-    headline = _rate3(nx, nt, np.float32)
-    _method_note("headline")
+    part("headline", lambda: _rate3(nx, nt, np.float32))
+    headline = configs.pop("headline", None)
 
     # roofline accounting for the headline row (multi-plane fused kernel:
     # T read 1.0x with the VMEM window handoff else (1+2/P)x, + Cp read
@@ -126,30 +178,26 @@ def main() -> None:
 
     sds = jax.ShapeDtypeStruct((nx, nx, nx), np.float32)
     P = mp_planes(sds)
-    bytes_per_cell = float(mp_bytes_per_cell(sds))
-    notes["window_handoff"] = bool(mp_handoff(sds))
-    effective_gbps = headline * bytes_per_cell / 1e9
+    # the traffic model must match how the rate was MEASURED: a degraded
+    # headline ran with the kernel variants off
+    if "headline_degraded" in notes:
+        with _variants_off():
+            bytes_per_cell = float(mp_bytes_per_cell(sds))
+            notes["window_handoff"] = bool(mp_handoff(sds))
+    else:
+        bytes_per_cell = float(mp_bytes_per_cell(sds))
+        notes["window_handoff"] = bool(mp_handoff(sds))
+    effective_gbps = (headline * bytes_per_cell / 1e9
+                      if headline is not None else None)
     try:
         kind = jax.devices()[0].device_kind
     except Exception:
         kind = ""
     peak = _hbm_peak(kind)
-    pct_peak = 100.0 * effective_gbps / peak if peak else None
+    pct_peak = (100.0 * effective_gbps / peak
+                if peak and effective_gbps is not None else None)
 
     # --- other configs (each guarded: a failed section records an error) ---
-    def part(name, fn):
-        try:
-            configs[name] = fn()
-            _method_note(name)
-        except Exception as e:  # pragma: no cover - evidence robustness
-            configs[name] = None
-            notes[name] = repr(e)[-300:]
-            try:
-                if igg.grid_is_initialized():
-                    igg.finalize_global_grid()
-            except Exception:
-                pass
-
     import jax.numpy as jnp
 
     part("diffusion3D_bf16", lambda: _rate3(
@@ -281,7 +329,7 @@ def main() -> None:
         "chunk windows (fixed dispatch/drain costs cancel); see module "
         "docstring")
     pct_meas = None
-    if configs.get("hbm_triad_GBps"):
+    if configs.get("hbm_triad_GBps") and effective_gbps is not None:
         pct_meas = 100.0 * effective_gbps / configs["hbm_triad_GBps"]
     if pct_peak is not None and pct_peak > 100:
         notes["roofline"] = (
@@ -294,7 +342,8 @@ def main() -> None:
         "metric": "diffusion3D_cell_updates_per_s_per_chip",
         "value": headline,
         "unit": "cell-updates/s/chip",
-        "vs_baseline": headline / baseline,
+        "vs_baseline": (headline / baseline
+                        if headline is not None else None),
         "dtype": "f32",
         "baseline_note": "reference anchor is f64 on P100; this row is f32 "
                          "(no native f64 pipeline on this TPU generation; "

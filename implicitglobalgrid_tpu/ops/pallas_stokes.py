@@ -326,43 +326,31 @@ def stokes_step_exchange_pallas(state, gg, modes, p, *, interpret=False):
     cP = (1, ny, nz)
     cY = (1, ny + 1, nz)
     cZ = (1, ny, nz + 1)
+    operands = [P, P, Vx, Vx, Vx, Vy, Vy, Vy, Vz, Vz, Vz,
+                dVx, dVy, dVz, rhog]
+    in_specs = [
+        spec(cP, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # P[i-1]
+        spec(cP, lambda i: (i, 0, 0)),                        # P[i]
+        spec(cP, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vx[i-1]
+        spec(cP, lambda i: (i, 0, 0)),                        # Vx[i]
+        spec(cP, lambda i: (i + 1, 0, 0)),                    # Vx[i+1]
+        spec(cY, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vy[i-1]
+        spec(cY, lambda i: (i, 0, 0)),                        # Vy[i]
+        spec(cY, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
+        spec(cZ, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vz[i-1]
+        spec(cZ, lambda i: (i, 0, 0)),                        # Vz[i]
+        spec(cZ, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
+        spec(cP, lambda i: (i, 0, 0)),                        # dVx[i]
+        spec(cY, lambda i: (i, 0, 0)),                        # dVy[i]
+        spec(cZ, lambda i: (i, 0, 0)),                        # dVz[i]
+        spec(cP, lambda i: (i, 0, 0)),                        # rhog[i]
+    ]
     if relay:
-        # [i-1] streams replaced by the in-kernel VMEM relay: 11 HBM input
-        # streams instead of 15
-        operands = [P, Vx, Vx, Vy, Vy, Vz, Vz, dVx, dVy, dVz, rhog]
-        in_specs = [
-            spec(cP, lambda i: (i, 0, 0)),                        # P[i]
-            spec(cP, lambda i: (i, 0, 0)),                        # Vx[i]
-            spec(cP, lambda i: (i + 1, 0, 0)),                    # Vx[i+1]
-            spec(cY, lambda i: (i, 0, 0)),                        # Vy[i]
-            spec(cY, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
-            spec(cZ, lambda i: (i, 0, 0)),                        # Vz[i]
-            spec(cZ, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
-            spec(cP, lambda i: (i, 0, 0)),                        # dVx[i]
-            spec(cY, lambda i: (i, 0, 0)),                        # dVy[i]
-            spec(cZ, lambda i: (i, 0, 0)),                        # dVz[i]
-            spec(cP, lambda i: (i, 0, 0)),                        # rhog[i]
-        ]
-    else:
-        operands = [P, P, Vx, Vx, Vx, Vy, Vy, Vy, Vz, Vz, Vz,
-                    dVx, dVy, dVz, rhog]
-        in_specs = [
-            spec(cP, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # P[i-1]
-            spec(cP, lambda i: (i, 0, 0)),                        # P[i]
-            spec(cP, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vx[i-1]
-            spec(cP, lambda i: (i, 0, 0)),                        # Vx[i]
-            spec(cP, lambda i: (i + 1, 0, 0)),                    # Vx[i+1]
-            spec(cY, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vy[i-1]
-            spec(cY, lambda i: (i, 0, 0)),                        # Vy[i]
-            spec(cY, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
-            spec(cZ, lambda i: (jnp.maximum(i - 1, 0), 0, 0)),    # Vz[i-1]
-            spec(cZ, lambda i: (i, 0, 0)),                        # Vz[i]
-            spec(cZ, lambda i: (jnp.minimum(i + 1, nx - 1), 0, 0)),
-            spec(cP, lambda i: (i, 0, 0)),                        # dVx[i]
-            spec(cY, lambda i: (i, 0, 0)),                        # dVy[i]
-            spec(cZ, lambda i: (i, 0, 0)),                        # dVz[i]
-            spec(cP, lambda i: (i, 0, 0)),                        # rhog[i]
-        ]
+        # [i-1] streams (operand indices: P 0, Vx 2, Vy 5, Vz 8) replaced
+        # by the in-kernel VMEM relay: 11 HBM input streams instead of 15
+        for idx in (8, 5, 2, 0):
+            del operands[idx]
+            del in_specs[idx]
 
     from .pallas_common import add_recv_operands, out_shape_with_vma
 
