@@ -13,6 +13,11 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+# Disable the supervision harness's wall-clock kill: bench children run
+# TPU-attached and must never be timeout-killed (bench_util._budget()
+# treats 0 as "no deadline, no attempt timeout").
+export IGG_BENCH_BUDGET=0
+
 echo "== bench.py (full evidence: headline + configs + triad + kernel checks)"
 python bench.py | tee BENCH_TPU.json
 

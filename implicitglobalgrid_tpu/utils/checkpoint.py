@@ -217,6 +217,15 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
         np.savez(f, **payload)
     os.replace(shard_path + ".tmp", shard_path)
 
+    # Barrier BEFORE process 0 writes meta.npz: meta carries the new save
+    # token, so it must be the COMMIT record of a fully-written shard set.
+    # Without this, a crash while other processes are still writing leaves
+    # a meta newer than some shards — detectable only at restore, with a
+    # non-uniform failure across processes.
+    from .timing import barrier
+
+    barrier()
+
     if pidx == 0:
         meta = _grid_meta(gg)
         meta[f"{_META_PREFIX}names"] = np.asarray(names)
@@ -242,8 +251,8 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
             if m and int(m.group(1)) >= jax.process_count():
                 os.remove(f)
 
-    from .timing import barrier
-
+    # Final barrier: no process returns (and possibly starts the NEXT
+    # save, or reports the checkpoint usable) before meta.npz exists.
     barrier()
 
 
@@ -312,18 +321,27 @@ def restore_checkpoint_sharded(dirpath, *, strict: bool = True):
     expect_token = str(meta["save_token"]) if "save_token" in meta else None
     token_key = f"{_META_PREFIX}save_token"
 
+    # Token-check EVERY shard file up front (cheap: npz loads members
+    # lazily, so this reads one tiny array per file), not just the files
+    # this process happens to scan for blocks.  A lazy per-scan check is
+    # non-SPMD-uniform: after an interrupted save, a process whose blocks
+    # all sit in its own (valid) shard file would restore successfully
+    # while others raise — hanging the multi-host run at the next
+    # collective instead of failing cleanly on every process.
+    if expect_token is not None:
+        for path in files:
+            with np.load(path) as z:
+                ftok = str(z[token_key]) if token_key in z.files else None
+            if ftok != expect_token:
+                raise IncoherentArgumentError(
+                    f"Shard file {path} belongs to a different save than "
+                    "meta.npz (save-token mismatch) — the save was "
+                    "interrupted; do not resume from this checkpoint.")
+
     def find_block(key: str):
         while key not in blocks and unscanned:
             path = unscanned.pop(0)
             with np.load(path) as z:
-                if expect_token is not None:
-                    ftok = str(z[token_key]) if token_key in z.files else None
-                    if ftok != expect_token:
-                        raise IncoherentArgumentError(
-                            f"Shard file {path} belongs to a different "
-                            "save than meta.npz (save-token mismatch) — "
-                            "the save was interrupted; do not resume from "
-                            "this checkpoint.")
                 for k in z.files:
                     if k in wanted:
                         blocks[k] = z[k]
