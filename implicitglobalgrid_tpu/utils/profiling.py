@@ -96,8 +96,8 @@ def _op_kind(name: str) -> str:
 _planes_cache: dict = {}
 
 
-def _device_planes(log_dir: str):
-    """Device planes of the newest capture; memoized on the capture files'
+def _all_planes(log_dir: str):
+    """All planes of the newest capture; memoized on the capture files'
     (path, mtime, size) so overlap_stats + op_breakdown on the same trace
     decode the (potentially large) protobuf once. Only the most recent
     trace is retained (size-1 cache): analyzing several large traces in
@@ -113,12 +113,15 @@ def _device_planes(log_dir: str):
         return hit[1]
     planes = []
     for path in files:
-        for plane in parse_xspace(path):
-            if plane.name.startswith("/device:"):
-                planes.append(plane)
+        planes.extend(parse_xspace(path))
     _planes_cache.clear()
     _planes_cache[log_dir] = (key, planes)
     return planes
+
+
+def _device_planes(log_dir: str):
+    return [p for p in _all_planes(log_dir)
+            if p.name.startswith("/device:")]
 
 
 def _merge(intervals):
@@ -163,8 +166,13 @@ def overlap_stats(log_dir: str):
     scheduler actually hid (the XLA analog of the reference overlapping
     its pack kernels and MPI traffic with user kernels on max-priority
     streams). Returns ``{device_name: {busy_us, compute_us, comm_us,
-    hidden_comm_us, exposed_comm_us, overlap_frac}}``; an empty dict means
-    no device plane was captured."""
+    hidden_comm_us, exposed_comm_us, overlap_frac}}``.
+
+    Captures with no ``/device:`` planes (the XLA:CPU backend, incl. the
+    virtual multi-device mesh) fall back to `_host_overlap_stats`, which
+    reads the same quantities off the runtime thread-pool lines and
+    returns one aggregate ``CPU:threadpool`` entry; an empty dict means
+    the capture had neither device planes nor pool events."""
     out = {}
     for plane in _device_planes(log_dir):
         comm = []
@@ -189,20 +197,90 @@ def overlap_stats(log_dir: str):
                     comm.append(iv)
                 elif line.name == "XLA Ops":
                     compute.append(iv)
-        comm_m, comm_total = _merge(comm)
-        comp_m, comp_total = _merge(compute)
-        busy = _merge(comm + compute)[1]
-        hidden = _intersect_total(comm_m, comp_m)
-        name = plane.name.replace("/device:", "")
-        out[name] = {
-            "busy_us": busy / 1e6,
-            "compute_us": comp_total / 1e6,
-            "comm_us": comm_total / 1e6,
-            "hidden_comm_us": hidden / 1e6,
-            "exposed_comm_us": (comm_total - hidden) / 1e6,
-            "overlap_frac": hidden / comm_total if comm_total else None,
-        }
+        out[plane.name.replace("/device:", "")] = _stats_from(comm, compute)
+    if not out:
+        out = _host_overlap_stats(log_dir)
     return out
+
+
+def _stats_from(comm, compute) -> dict:
+    """The shared stats record of both the device-plane and host-fallback
+    paths: merged totals, busy union, and comm∩compute = hidden."""
+    comm_m, comm_total = _merge(comm)
+    comp_m, comp_total = _merge(compute)
+    busy = _merge(comm + compute)[1]
+    hidden = _intersect_total(comm_m, comp_m)
+    return {
+        "busy_us": busy / 1e6,
+        "compute_us": comp_total / 1e6,
+        "comm_us": comm_total / 1e6,
+        "hidden_comm_us": hidden / 1e6,
+        "exposed_comm_us": (comm_total - hidden) / 1e6,
+        "overlap_frac": hidden / comm_total if comm_total else None,
+    }
+
+
+# Runtime-infrastructure event names on the host thread lines that must
+# count as COMMUNICATION: the XLA:CPU backend implements cross-(virtual-)
+# device collectives by in-process rendezvous, so a device's exchange
+# appears as a `ppermute` thunk span plus nested Rendezvous waits.
+_HOST_COMM_RE = re.compile(
+    r"^(Rendezvous|InvokeRendezvous|Wait for rendezvous)|^psum",
+)
+
+
+def _host_overlap_stats(log_dir: str):
+    """Comm/compute overlap from the HOST thread-pool lines — the fallback
+    when the capture has no ``/device:`` planes (the XLA:CPU backend, incl.
+    the virtual ``--xla_force_host_platform_device_count`` mesh, attributes
+    op execution to runtime pool threads of ``/host:CPU``, not to device
+    planes).
+
+    Classification on the pool (``tf_*``) lines: comm = collective op
+    kinds (`_COMM_RE`) plus the CPU backend's rendezvous machinery
+    (`_HOST_COMM_RE` — ppermute spans block in an in-process rendezvous,
+    the CPU analog of an exposed wire transfer); compute = HLO thunk spans,
+    recognized as lowercase-named events (``wrapped_add``, ``fusion.3``,
+    ``copy.15``…) that are not C++ infrastructure (``::``), not completion
+    markers (``end: …``), and not the ``while`` control-flow container
+    (its span covers the whole loop body, comm included).
+
+    All pool threads aggregate into ONE ``CPU:threadpool`` entry: virtual
+    devices share the pool, so per-thread attribution is meaningless.
+    ``hidden_comm_us`` is comm time during which at least one thread was
+    computing — communication the runtime actually covered with useful
+    work; ``exposed_comm_us`` is comm time with the whole pool idle or
+    blocked, the quantity that transfers to ICI-exposed time on hardware
+    (round-4 verdict: separate core contention from exposed collectives).
+
+    Caveat: the window must not contain a compile (warm every chunk size
+    first) — compiler passes run on the same pool and a CamelCase pass
+    name slipping through the lowercase filter is not compute."""
+    comm = []
+    compute = []
+    for plane in _all_planes(log_dir):
+        if not plane.name.startswith("/host:CPU"):
+            continue
+        for line in plane.lines:
+            if not line.name.startswith("tf_"):
+                continue
+            for ev in line.events:
+                if ev.duration_ps <= 0:
+                    continue
+                if ev.name.startswith("end: "):
+                    continue  # completion markers are neither comm nor
+                    # compute — excluded BEFORE the comm match, or
+                    # 'end: ppermute.3' would count as a comm span
+                iv = (ev.start_ps, ev.end_ps)
+                kind = _op_kind(ev.name)
+                if _COMM_RE.search(kind) or _HOST_COMM_RE.search(ev.name):
+                    comm.append(iv)
+                elif (ev.name[:1].islower() and "::" not in ev.name
+                      and kind != "while"):
+                    compute.append(iv)
+    if not comm and not compute:
+        return {}
+    return {"CPU:threadpool": _stats_from(comm, compute)}
 
 
 def op_breakdown(log_dir: str, top: int = 12):

@@ -113,6 +113,62 @@ def test_overlap_stats_arithmetic(tmp_path):
     assert abs(s["busy_us"] - 5.0) < 1e-9  # union [15,17) u [16,20)
 
 
+def test_host_overlap_fallback(tmp_path):
+    """A capture with NO /device: planes (the XLA:CPU backend) falls back
+    to the runtime thread-pool lines: ppermute thunk spans + Rendezvous
+    waits are comm, lowercase HLO thunk spans are compute, and C++
+    infrastructure / 'Wait:' / 'end:' markers / 'while' containers are
+    neither."""
+    from implicitglobalgrid_tpu.utils.profiling import overlap_stats
+
+    metas = [(1, _meta(1, "wrapped_add")),
+             (2, _meta(2, "ppermute.42")),
+             (3, _meta(3, "ThunkExecutor::Execute")),
+             (4, _meta(4, "Wait: pending_threads=1/8")),
+             (5, _meta(5, "end: ppermute.42")),
+             (6, _meta(6, "Rendezvous")),
+             (7, _meta(7, "while.3"))]
+    lines = [
+        # thread 1: compute [0,4)us, ppermute comm [2,8)us, infra ignored;
+        # the 'end: ppermute' marker sits OUTSIDE every other span at
+        # [9.5,10.5)us so a misclassification (as comm OR compute) would
+        # change the totals below
+        _line("tf_XLAEigen/1", 0, [_event(1, 0, 4_000_000),
+                                   _event(2, 2_000_000, 6_000_000),
+                                   _event(3, 0, 10_000_000),
+                                   _event(5, 9_500_000, 1_000_000)]),
+        # thread 2: Rendezvous comm [6,9)us; 'while'/'Wait:' ignored
+        _line("tf_XLAEigen/2", 0, [_event(6, 6_000_000, 3_000_000),
+                                   _event(7, 0, 9_000_000),
+                                   _event(4, 0, 10_000_000)]),
+    ]
+    _write_run(tmp_path, [_plane("/host:CPU", lines, metas)])
+
+    stats = overlap_stats(str(tmp_path))
+    s = stats["CPU:threadpool"]
+    assert abs(s["compute_us"] - 4.0) < 1e-9
+    assert abs(s["comm_us"] - 7.0) < 1e-9        # [2,8) u [6,9)
+    assert abs(s["hidden_comm_us"] - 2.0) < 1e-9  # comm over compute [2,4)
+    assert abs(s["exposed_comm_us"] - 5.0) < 1e-9
+    assert abs(s["busy_us"] - 9.0) < 1e-9
+
+
+def test_device_planes_preempt_host_fallback(tmp_path):
+    """When a device plane exists, host thread-pool lines are ignored —
+    the fallback is only for captures with no device attribution."""
+    from implicitglobalgrid_tpu.utils.profiling import overlap_stats
+
+    dev_metas = [(1, _meta(1, "%f = f32[8]{0} fusion(%a)"))]
+    dev_lines = [_line("XLA Ops", 0, [_event(1, 0, 2_000_000)])]
+    host_metas = [(1, _meta(1, "ppermute.7"))]
+    host_lines = [_line("tf_XLAEigen/1", 0, [_event(1, 0, 5_000_000)])]
+    _write_run(tmp_path, [_plane("/device:TPU:0", dev_lines, dev_metas),
+                          _plane("/host:CPU", host_lines, host_metas)])
+
+    stats = overlap_stats(str(tmp_path))
+    assert "TPU:0" in stats and "CPU:threadpool" not in stats
+
+
 def test_op_breakdown_synthetic(tmp_path):
     from implicitglobalgrid_tpu.utils.profiling import op_breakdown
 
