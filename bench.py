@@ -72,7 +72,7 @@ def main() -> None:
     import implicitglobalgrid_tpu as igg
     from implicitglobalgrid_tpu.models import (
         init_acoustic3d, init_diffusion2d, init_diffusion3d, init_stokes3d,
-        make_run, run_acoustic, run_diffusion, run_stokes,
+        make_run, make_run_sr, run_acoustic, run_diffusion, run_stokes,
     )
 
     nd = len(jax.devices())
@@ -213,6 +213,27 @@ def main() -> None:
 
     part("diffusion3D_bf16", lambda: _rate3(
         64 if cpu else 256, 10 if cpu else 600, jnp.bfloat16))
+
+    # bf16 with stochastic-rounding storage (ops/precision.py): the
+    # accuracy-preserving bf16 mode (bench_f64_accuracy.py's bf16_sr leg);
+    # XLA tier with per-step PRNG, so it prices what correct bf16 costs
+    # vs the round-to-nearest bandwidth row above.
+    def _rate3_sr():
+        nxs, c1 = (64, 10) if cpu else (256, 200)
+        _grid3(nxs)
+        try:
+            T, Cp, p = init_diffusion3d(dtype=jnp.bfloat16, sr=True)
+
+            def chunk(c):
+                igg.sync(tuple(make_run_sr(p, c)(T, Cp, jnp.int32(0))))
+
+            s = two_point(chunk, c1, 3 * c1)
+            cells = float(igg.nx_g()) * float(igg.ny_g()) * float(igg.nz_g())
+            return cells / s / n_chips
+        finally:
+            igg.finalize_global_grid()
+
+    part("diffusion3D_bf16_sr", _rate3_sr, variants=False)
 
     def _rate2():
         nx2, c1 = (64, 10) if cpu else (4096, 200)

@@ -54,7 +54,12 @@ def main() -> None:
             (np.float32, "f32", None, False),
             (np.float64, "f64_bf16ic", None, True),
             (jnp.bfloat16, "bf16_xla", "xla", False),
-            (jnp.bfloat16, "bf16_kernel", "pallas_interpret", False))
+            (jnp.bfloat16, "bf16_kernel", "pallas_interpret", False),
+            # stochastic-rounding bf16 storage (ops/precision.py): f32
+            # compute, unbiased bf16 store — the leg that decides whether
+            # bf16 is a correctness-preserving mode or only a bandwidth
+            # study (round-4 verdict)
+            (jnp.bfloat16, "bf16_sr", "sr", False))
     for dtype, tag, impl, bf16_ic in legs:
         igg.init_global_grid(nx, nx, nx, dimx=dims[0], dimy=dims[1],
                              dimz=dims[2], periodx=1, periody=1, periodz=1,
@@ -67,9 +72,9 @@ def main() -> None:
             T = igg.device_put_g(np.asarray(Tb).astype(dtype))
             Cp = igg.device_put_g(np.asarray(Cpb).astype(dtype))
         else:
-            T, Cp, p = init_diffusion3d(dtype=dtype)
+            T, Cp, p = init_diffusion3d(dtype=dtype, sr=(impl == "sr"))
         out = run_diffusion(T, Cp, p, nt, nt_chunk=max(1, nt // 4),
-                            impl=impl)
+                            impl=None if impl == "sr" else impl)
         finals[tag] = np.asarray(igg.gather_interior(out), dtype=np.float64)
         igg.finalize_global_grid()
 
@@ -78,7 +83,8 @@ def main() -> None:
     drift = {}
     for tag, ref_tag in (("f32", "f64"), ("f64_bf16ic", "f64"),
                          ("bf16_xla", "f64_bf16ic"),
-                         ("bf16_kernel", "f64_bf16ic")):
+                         ("bf16_kernel", "f64_bf16ic"),
+                         ("bf16_sr", "f64_bf16ic")):
         d = finals[tag] - finals[ref_tag]
         drift[tag] = {
             "vs": ref_tag,
@@ -99,7 +105,8 @@ def main() -> None:
                 "f64_bf16ic (vs f64) is the irreducible bf16 IC "
                 "quantization; bf16_xla / bf16_kernel compare against it, "
                 "isolating ARITHMETIC drift: native bf16 flux arithmetic "
-                "vs the kernel tier's bf16-storage/f32-compute recipe",
+                "vs the kernel tier's bf16-storage/f32-compute recipe vs "
+                "stochastic-rounding storage (bf16_sr, ops/precision.py)",
     }))
 
 

@@ -44,6 +44,7 @@ from .ops.gather import gather, gather_interior, gather_sub
 from .ops.alloc import zeros_g, ones_g, full_g, device_put_g, sharding_of
 from .ops.fields import Field, wrap_field, extract, local_shape_of, stacked_shape
 from .ops.stencil import d_xa, d_ya, d_za, d_xi, d_yi, d_zi, inn
+from .ops.precision import stochastic_round_bf16
 from .tools import (
     nx_g, ny_g, nz_g, x_g, y_g, z_g, x_g_vec, y_g_vec, z_g_vec, coords_g,
 )
@@ -70,6 +71,7 @@ __all__ = [
     "save_checkpoint", "restore_checkpoint", "load_checkpoint",
     "save_checkpoint_sharded", "restore_checkpoint_sharded",
     "d_xa", "d_ya", "d_za", "d_xi", "d_yi", "d_zi", "inn",
+    "stochastic_round_bf16",
     # state/introspection
     "AXIS_NAMES", "NDIMS", "PROC_NULL", "GlobalGrid", "global_grid",
     "get_global_grid", "grid_is_initialized", "check_initialized",

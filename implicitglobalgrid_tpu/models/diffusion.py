@@ -28,7 +28,8 @@ from ..parallel.topology import check_initialized, global_grid
 from ..tools import coords_g, nx_g, ny_g, nz_g
 
 __all__ = ["DiffusionParams", "init_diffusion3d", "init_diffusion2d",
-           "diffusion_step_local", "make_step", "make_run", "run_diffusion"]
+           "diffusion_step_local", "make_step", "make_run", "make_run_sr",
+           "run_diffusion"]
 
 
 @dataclass(frozen=True)
@@ -42,13 +43,25 @@ class DiffusionParams:
     step (small local blocks in strong scaling, DCN-crossing axes); at the
     256^3 anchor size on ICI the default data-flow scheduling is faster.
     The Pallas fused step+exchange path structures communication itself and
-    ignores this flag."""
+    ignores this flag.
+
+    ``sr`` enables STOCHASTIC-ROUNDING bf16 storage (`ops/precision.py`):
+    the state stays bf16 in HBM (the bandwidth tier) but each step computes
+    in f32 and rounds the store stochastically, which removes the
+    increment-absorption bias that stagnates plain-bf16 long runs
+    (bench_f64_accuracy.py). Runner-level feature (`make_run_sr`/
+    `run_diffusion` thread the per-step PRNG); currently XLA-tier only —
+    the Pallas kernels would need an in-kernel PRNG, pending hardware
+    validation — and, like the Pallas tier, it ignores ``overlap``. No
+    effect unless the state dtype is bfloat16."""
     lam: float      # thermal conductivity
     dt: float
     dx: float
     dy: float = 1.0
     dz: float = 1.0
     overlap: bool = False
+    sr: bool = False
+    sr_seed: int = 0
 
 
 def _gaussian(x, amp, cx, w=1.0):
@@ -57,8 +70,28 @@ def _gaussian(x, amp, cx, w=1.0):
     return amp * jnp.exp(-(((x - cx) / w) ** 2))
 
 
+def _upd3(Tb, Cpb, p: DiffusionParams):
+    """The 3-D flux/divergence/update stencil — ONE definition shared by
+    the plain-XLA, overlap, and stochastic-rounding paths (the accuracy
+    bench compares their trajectories; the arithmetic must not fork)."""
+    qx = -p.lam * d_xi(Tb) / p.dx
+    qy = -p.lam * d_yi(Tb) / p.dy
+    qz = -p.lam * d_zi(Tb) / p.dz
+    dTdt = (-d_xa(qx) / p.dx - d_ya(qy) / p.dy
+            - d_za(qz) / p.dz) / inn(Cpb)
+    return Tb.at[1:-1, 1:-1, 1:-1].add(p.dt * dTdt)
+
+
+def _upd2(Tb, Cpb, p: DiffusionParams):
+    """2-D variant of `_upd3`."""
+    qx = -p.lam * d_xi(Tb) / p.dx
+    qy = -p.lam * d_yi(Tb) / p.dy
+    dTdt = (-d_xa(qx) / p.dx - d_ya(qy) / p.dy) / inn(Cpb)
+    return Tb.at[1:-1, 1:-1].add(p.dt * dTdt)
+
+
 def init_diffusion3d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, lz=10.0,
-                     dtype=None, overlap=False):
+                     dtype=None, overlap=False, sr=False, sr_seed=0):
     """Build (T, Cp, params) with the reference example's initial conditions
     (two Gaussian anomalies each,
     `diffusion3D_multigpu_CuArrays_novis.jl:34-38`) as stacked sharded arrays.
@@ -84,7 +117,7 @@ def init_diffusion3d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, lz=10.0,
     T = device_put_g(jnp.broadcast_to(T, Tz.shape).astype(Tz.dtype))
     Cp = device_put_g(jnp.broadcast_to(Cp, Tz.shape).astype(Tz.dtype))
     return T, Cp, DiffusionParams(lam=lam, dt=dt, dx=dx, dy=dy, dz=dz,
-                                  overlap=overlap)
+                                  overlap=overlap, sr=sr, sr_seed=sr_seed)
 
 
 def init_diffusion2d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, dtype=None):
@@ -106,7 +139,8 @@ def init_diffusion2d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, dtype=None):
     return T, Cp, DiffusionParams(lam=lam, dt=dt, dx=dx, dy=dy)
 
 
-def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
+def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla",
+                         sr_key=None):
     """One time step on a LOCAL block (use inside shard_map) — the reference
     hot loop (`diffusion3D_multicpu_novis.jl:41-47`):
 
@@ -116,7 +150,23 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
     single-pass Pallas TPU kernel, same arithmetic to the last ulp;
     "pallas_interpret" for CPU testing). Pallas covers 3-D and 2-D
     blocks; other ndims fall back to the XLA path.
+
+    ``sr_key`` (with ``p.sr`` and a bfloat16 state) selects the
+    stochastic-rounding storage path: f32 flux arithmetic, bf16 store with
+    an unbiased round (`ops/precision.py`) — removes the plain-bf16
+    stagnation bias. XLA formulation (the kernel tier has no in-kernel
+    PRNG yet).
     """
+    import jax.numpy as jnp
+
+    if (p.sr and sr_key is not None and T.dtype == jnp.bfloat16
+            and T.ndim in (2, 3)):
+        from ..ops.precision import shard_unique_fold, stochastic_round_bf16
+
+        key = shard_unique_fold(sr_key)
+        upd = _upd3 if T.ndim == 3 else _upd2
+        Tf = upd(T.astype(jnp.float32), Cp.astype(jnp.float32), p)
+        return local_update_halo(stochastic_round_bf16(Tf, key))
     if impl.startswith("pallas") and T.ndim == 3:
         from ..ops.halo import _dim_exchanges
         from ..ops.pallas_stencil import (
@@ -188,12 +238,7 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
         return diffusion_step_local(T, Cp, p, impl="xla")
     elif T.ndim == 3:
         def upd(Tb, Cpb):
-            qx = -p.lam * d_xi(Tb) / p.dx
-            qy = -p.lam * d_yi(Tb) / p.dy
-            qz = -p.lam * d_zi(Tb) / p.dz
-            dTdt = (-d_xa(qx) / p.dx - d_ya(qy) / p.dy
-                    - d_za(qz) / p.dz) / inn(Cpb)
-            return Tb.at[1:-1, 1:-1, 1:-1].add(p.dt * dTdt)
+            return _upd3(Tb, Cpb, p)
 
         if p.overlap:
             from ..ops.overlap import hide_communication
@@ -202,10 +247,7 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla"):
         T = upd(T, Cp)
     else:
         def upd2(Tb, Cpb):
-            qx = -p.lam * d_xi(Tb) / p.dx
-            qy = -p.lam * d_yi(Tb) / p.dy
-            dTdt = (-d_xa(qx) / p.dx - d_ya(qy) / p.dy) / inn(Cpb)
-            return Tb.at[1:-1, 1:-1].add(p.dt * dTdt)
+            return _upd2(Tb, Cpb, p)
 
         if p.overlap:
             from ..ops.overlap import hide_communication
@@ -271,12 +313,51 @@ def make_run(p: DiffusionParams, nt_chunk: int, ndim: int = 3,
     )
 
 
+def make_run_sr(p: DiffusionParams, nt_chunk: int, ndim: int = 3):
+    """Stochastic-rounding runner: state is ``(T, Cp, n)`` with ``n`` a
+    replicated scalar GLOBAL step counter — the per-step PRNG key is
+    ``fold_in(PRNGKey(p.sr_seed), n)``, so randomness never repeats across
+    chunk calls (a chunk-local loop index would reuse the same stream
+    every chunk, correlating the round directions of successive chunks).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .common import make_state_runner
+
+    def step(state):
+        T, Cp, n = state
+        key = jax.random.fold_in(jax.random.PRNGKey(p.sr_seed), n)
+        T = diffusion_step_local(T, Cp, p, impl="xla", sr_key=key)
+        return T, Cp, n + jnp.int32(1)
+
+    return make_state_runner(step, (ndim, ndim, 0), nt_chunk=nt_chunk,
+                             key=("diffusion_sr", p))
+
+
 def run_diffusion(T, Cp, p: DiffusionParams, nt: int, *, nt_chunk: int = 100,
                   impl: str | None = None):
-    """Advance ``nt`` steps, compiling at most two chunk sizes."""
+    """Advance ``nt`` steps, compiling at most two chunk sizes. With
+    ``p.sr`` and a bfloat16 state, routes through the stochastic-rounding
+    runner (the step counter is threaded internally)."""
+    import jax.numpy as jnp
+
     from .common import run_chunked
 
     ndim = T.ndim
+    if p.sr and T.dtype == jnp.bfloat16:
+        if impl is not None and not impl.startswith("xla"):
+            from ..utils.exceptions import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"impl={impl!r} is incompatible with DiffusionParams(sr="
+                "True) on a bfloat16 state: stochastic-rounding storage "
+                "currently runs only the XLA tier (the Pallas kernels "
+                "have no in-kernel PRNG yet). Pass impl=None/'xla' or "
+                "disable sr.")
+        T, Cp, _ = run_chunked(lambda c: make_run_sr(p, c, ndim),
+                               (T, Cp, jnp.int32(0)), nt, nt_chunk)
+        return T
     T, Cp = run_chunked(lambda c: make_run(p, c, ndim, impl), (T, Cp),
                         nt, nt_chunk)
     return T
