@@ -159,8 +159,18 @@ def diffusion_step_local(T, Cp, p: DiffusionParams, impl: str = "xla",
     """
     import jax.numpy as jnp
 
-    if (p.sr and sr_key is not None and T.dtype == jnp.bfloat16
-            and T.ndim in (2, 3)):
+    if p.sr and T.dtype == jnp.bfloat16 and T.ndim in (2, 3):
+        if sr_key is None:
+            # make_step/make_run have no PRNG to thread — silently running
+            # plain round-to-nearest here would reintroduce the exact
+            # stagnation sr=True exists to prevent
+            from ..utils.exceptions import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                "DiffusionParams(sr=True) with a bfloat16 state needs the "
+                "stochastic-rounding runner: use run_diffusion or "
+                "make_run_sr (make_step/make_run cannot thread the "
+                "per-step PRNG key).")
         from ..ops.precision import shard_unique_fold, stochastic_round_bf16
 
         key = shard_unique_fold(sr_key)

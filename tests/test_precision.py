@@ -71,11 +71,32 @@ def test_sr_storage_fixes_bf16_stagnation():
     err_plain = np.abs(plain - ref).max() / scale
     err_sr = np.abs(srd - ref).max() / scale
     # plain bf16 stagnates (large deterministic bias); SR tracks the f32
-    # trajectory to ~1e-2 — at least 5x better here, ~36x at the
-    # bench_f64_accuracy.py config
+    # trajectory to ~1e-2 — ~36x better at this 24³/200-step spot check,
+    # ~86x (0.848 -> 0.0098) at the bench_f64_accuracy.py config
+    # (F64_ACCURACY.json); the assertion keeps slack for RNG variation
     assert err_plain > 0.1
     assert err_sr < 0.05
     assert err_sr < err_plain / 5
+
+
+def test_sr_requires_sr_runner():
+    """make_run/make_step cannot thread the per-step PRNG: driving an
+    sr=True bf16 state through them must raise, not silently run plain
+    round-to-nearest (the stagnation sr exists to prevent)."""
+    import jax.numpy as jnp
+
+    from implicitglobalgrid_tpu.models import make_run
+    from implicitglobalgrid_tpu.utils.exceptions import InvalidArgumentError
+
+    igg.init_global_grid(24, 24, 24, dimx=2, dimy=2, dimz=2, quiet=True)
+    try:
+        T, Cp, p = init_diffusion3d(dtype=jnp.bfloat16, sr=True)
+        with pytest.raises(InvalidArgumentError):
+            make_run(p, 2, impl="xla")(T, Cp)
+        with pytest.raises(InvalidArgumentError):
+            run_diffusion(T, Cp, p, 2, impl="pallas_interpret")
+    finally:
+        igg.finalize_global_grid()
 
 
 def test_sr_deterministic_per_seed():
