@@ -337,7 +337,10 @@ def make_run_sr(p: DiffusionParams, nt_chunk: int, ndim: int = 3):
 
     def step(state):
         T, Cp, n = state
-        key = jax.random.fold_in(jax.random.PRNGKey(p.sr_seed), n)
+        # 'rbg' keys draw from lax.rng_bit_generator — the TPU's hardware
+        # RNG path, much cheaper per bit than threefry's ALU lattice on a
+        # bandwidth-bound step (and supported on cpu/gpu backends too)
+        key = jax.random.fold_in(jax.random.key(p.sr_seed, impl="rbg"), n)
         T = diffusion_step_local(T, Cp, p, impl="xla", sr_key=key)
         return T, Cp, n + jnp.int32(1)
 
