@@ -3,7 +3,8 @@ re-designed whole-loop-jitted for TPU)."""
 
 from .diffusion import (
     DiffusionParams, init_diffusion3d, init_diffusion2d,
-    diffusion_step_local, make_step, make_run, make_run_sr,
+    diffusion_step_local, make_step, make_run, make_run_deep,
+    make_run_sr,
     run_diffusion,
 )
 from .acoustic import (
@@ -17,7 +18,8 @@ from .stokes import (
 
 __all__ = [
     "DiffusionParams", "init_diffusion3d", "init_diffusion2d",
-    "diffusion_step_local", "make_step", "make_run", "make_run_sr",
+    "diffusion_step_local", "make_step", "make_run", "make_run_deep",
+    "make_run_sr",
     "run_diffusion",
     "AcousticParams", "init_acoustic3d", "acoustic_step_local",
     "make_acoustic_run", "run_acoustic",

@@ -29,7 +29,7 @@ from ..tools import coords_g, nx_g, ny_g, nz_g
 
 __all__ = ["DiffusionParams", "init_diffusion3d", "init_diffusion2d",
            "diffusion_step_local", "make_step", "make_run", "make_run_sr",
-           "run_diffusion"]
+           "make_run_deep", "run_diffusion"]
 
 
 @dataclass(frozen=True)
@@ -53,7 +53,20 @@ class DiffusionParams:
     `run_diffusion` thread the per-step PRNG); currently XLA-tier only —
     the Pallas kernels would need an in-kernel PRNG, pending hardware
     validation — and, like the Pallas tier, it ignores ``overlap``. No
-    effect unless the state dtype is bfloat16."""
+    effect unless the state dtype is bfloat16.
+
+    ``comm_every`` enables COMMUNICATION-AVOIDING deep-halo stepping: with
+    halowidths >= k the exchange runs once per k steps (k-wide slabs), and
+    between exchanges each sub-step updates a region that retreats one
+    cell per sub-step from every side that has a neighbor — the cells it
+    skips are halo-band cells the NEXT exchange overwrites anyway, so the
+    interior trajectory is bit-identical to comm_every=1 (asserted by
+    tests/test_comm_avoid.py). Same wire bytes per step; 1/k the
+    collective count and latency — the lever for latency-bound regimes
+    (small blocks in strong scaling, DCN-crossing axes; see
+    `exposed_comm_ms_per_step` in WEAK_SCALING.json). XLA tier; ignores
+    ``overlap``; init the grid with overlaps >= 2k (e.g.
+    ``init_global_grid(..., overlaps=(2*k,)*3, halowidths=(k,)*3)``)."""
     lam: float      # thermal conductivity
     dt: float
     dx: float
@@ -62,6 +75,7 @@ class DiffusionParams:
     overlap: bool = False
     sr: bool = False
     sr_seed: int = 0
+    comm_every: int = 1
 
 
 def _gaussian(x, amp, cx, w=1.0):
@@ -90,8 +104,39 @@ def _upd2(Tb, Cpb, p: DiffusionParams):
     return Tb.at[1:-1, 1:-1].add(p.dt * dTdt)
 
 
+def _fresh_mask(shape, j: int, gg):
+    """Cells whose radius-1 dependencies are fresh at deep-halo sub-step
+    ``j`` (True = apply the update): per dim, ``[1 + j·L, n-1 - j·R)``
+    where L/R flag a neighbor on that side of THIS shard — `lax.axis_index`
+    per mesh axis, so one SPMD program serves edge and interior shards
+    (periodic sides always have a neighbor, incl. self). The skipped
+    halo-band cells keep stale values; the next k-wide exchange overwrites
+    exactly those cells with the neighbor's fresh copies, which is why the
+    interior trajectory matches comm_every=1 bit-for-bit."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..parallel.topology import AXIS_NAMES
+
+    m = None
+    for d in range(len(shape)):
+        idx = lax.axis_index(AXIS_NAMES[d])
+        per = bool(int(gg.periods[d]))
+        has_l = jnp.logical_or(idx > 0, per)
+        has_r = jnp.logical_or(idx < int(gg.dims[d]) - 1, per)
+        i = jnp.arange(shape[d])
+        lo = 1 + jnp.where(has_l, j, 0)
+        hi = shape[d] - 1 - jnp.where(has_r, j, 0)
+        md = (i >= lo) & (i < hi)
+        md = md.reshape([-1 if dd == d else 1
+                         for dd in range(len(shape))])
+        m = md if m is None else m & md
+    return m
+
+
 def init_diffusion3d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, lz=10.0,
-                     dtype=None, overlap=False, sr=False, sr_seed=0):
+                     dtype=None, overlap=False, sr=False, sr_seed=0,
+                     comm_every=1):
     """Build (T, Cp, params) with the reference example's initial conditions
     (two Gaussian anomalies each,
     `diffusion3D_multigpu_CuArrays_novis.jl:34-38`) as stacked sharded arrays.
@@ -117,7 +162,8 @@ def init_diffusion3d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, lz=10.0,
     T = device_put_g(jnp.broadcast_to(T, Tz.shape).astype(Tz.dtype))
     Cp = device_put_g(jnp.broadcast_to(Cp, Tz.shape).astype(Tz.dtype))
     return T, Cp, DiffusionParams(lam=lam, dt=dt, dx=dx, dy=dy, dz=dz,
-                                  overlap=overlap, sr=sr, sr_seed=sr_seed)
+                                  overlap=overlap, sr=sr, sr_seed=sr_seed,
+                                  comm_every=comm_every)
 
 
 def init_diffusion2d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, dtype=None):
@@ -280,11 +326,25 @@ def _resolve_impl(impl, ndim=3):
     return resolve_pallas_impl(impl, eligible=ndim in (2, 3))
 
 
+def _reject_comm_every(p: DiffusionParams, what: str):
+    """make_step/make_run advance one exchange per step — silently running
+    them with comm_every > 1 would measure nothing; route to
+    `make_run_deep`/`run_diffusion` instead (same precedent as sr)."""
+    if p.comm_every > 1:
+        from ..utils.exceptions import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"DiffusionParams(comm_every={p.comm_every}) needs the "
+            f"deep-halo runner: use run_diffusion or make_run_deep "
+            f"({what} exchanges every step and cannot honor the cadence).")
+
+
 def make_step(p: DiffusionParams, ndim: int = 3, impl: str | None = None):
     """Controller-level jitted single step on stacked arrays:
     ``T = step(T, Cp)``."""
     import jax
 
+    _reject_comm_every(p, "make_step")
     check_initialized()
     gg = global_grid()
     spec = field_partition_spec(ndim)
@@ -310,6 +370,7 @@ def make_run(p: DiffusionParams, nt_chunk: int, ndim: int = 3,
     ``(T, Cp)`` with ``Cp`` carried through unchanged."""
     from .common import make_state_runner
 
+    _reject_comm_every(p, "make_run")
     impl = _resolve_impl(impl, ndim)
 
     def step(state):
@@ -348,6 +409,53 @@ def make_run_sr(p: DiffusionParams, nt_chunk: int, ndim: int = 3):
                              key=("diffusion_sr", p))
 
 
+def make_run_deep(p: DiffusionParams, nt_chunk_super: int, ndim: int = 3):
+    """Communication-avoiding runner: ONE super-step = ``p.comm_every``
+    masked sub-steps (`_fresh_mask`) + ONE k-wide exchange.
+    ``nt_chunk_super`` counts super-steps (physical steps / k)."""
+    import jax.numpy as jnp
+
+    from ..utils.exceptions import IncoherentArgumentError
+    from .common import make_state_runner
+
+    check_initialized()
+    gg = global_grid()
+    k = int(p.comm_every)
+    for d in range(ndim):
+        exchanging = int(gg.dims[d]) > 1 or int(gg.periods[d])
+        if exchanging and int(gg.halowidths[d]) < k:
+            raise IncoherentArgumentError(
+                f"comm_every={k} needs halowidths[{d}] >= {k} on every "
+                f"exchanging dim (got {int(gg.halowidths[d])}): init the "
+                f"grid with overlaps >= {2 * k} and halowidths=({k},...).")
+        # freshness bound: the right-send slab starts at n-ol and every
+        # sent cell must lie inside the LAST sub-step's updated region
+        # [k, n-k) — n >= ol + k, or an interior shard ships a value one
+        # sub-step stale and the bit-identical guarantee silently breaks
+        n_d = int(gg.nxyz[d])
+        ol_d = int(gg.overlaps[d])
+        if exchanging and n_d < ol_d + k:
+            raise IncoherentArgumentError(
+                f"comm_every={k} needs local size >= overlap + {k} on "
+                f"dim {d} (got n={n_d}, overlap={ol_d}): the send slabs "
+                "would leave the freshly-updated region.")
+
+    upd = _upd3 if ndim == 3 else _upd2
+
+    def step(state):
+        T, Cp = state
+        for j in range(k):
+            Tn = upd(T, Cp, p)
+            if j:
+                T = jnp.where(_fresh_mask(T.shape, j, gg), Tn, T)
+            else:
+                T = Tn  # sub-step 0 updates the full interior
+        return local_update_halo(T), Cp
+
+    return make_state_runner(step, (ndim, ndim), nt_chunk=nt_chunk_super,
+                             key=("diffusion_deep", p))
+
+
 def run_diffusion(T, Cp, p: DiffusionParams, nt: int, *, nt_chunk: int = 100,
                   impl: str | None = None):
     """Advance ``nt`` steps, compiling at most two chunk sizes. With
@@ -358,6 +466,25 @@ def run_diffusion(T, Cp, p: DiffusionParams, nt: int, *, nt_chunk: int = 100,
     from .common import run_chunked
 
     ndim = T.ndim
+    if p.comm_every > 1:
+        from ..utils.exceptions import InvalidArgumentError
+
+        k = int(p.comm_every)
+        if p.sr and T.dtype == jnp.bfloat16:  # sr is a no-op otherwise
+            raise InvalidArgumentError(
+                "comm_every > 1 with sr=True is not supported yet (the "
+                "deep-halo runner has no PRNG threading).")
+        if impl is not None and not impl.startswith("xla"):
+            raise InvalidArgumentError(
+                f"impl={impl!r} is incompatible with comm_every={k}: "
+                "deep-halo stepping currently runs only the XLA tier.")
+        if nt % k:
+            raise InvalidArgumentError(
+                f"nt={nt} must be a multiple of comm_every={k} (the "
+                "exchange cadence defines the trajectory).")
+        T, Cp = run_chunked(lambda c: make_run_deep(p, c, ndim),
+                            (T, Cp), nt // k, max(1, nt_chunk // k))
+        return T
     if p.sr and T.dtype == jnp.bfloat16:
         if impl is not None and not impl.startswith("xla"):
             from ..utils.exceptions import InvalidArgumentError

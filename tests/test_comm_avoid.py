@@ -1,0 +1,168 @@
+"""Communication-avoiding deep-halo stepping (`comm_every=k`): the interior
+trajectory must be BIT-IDENTICAL to the exchange-every-step scheme — the
+skipped halo-band cells are exactly the cells the k-wide exchange
+overwrites, so the masked sub-steps (`diffusion._fresh_mask`) change the
+collective cadence, never the numbers."""
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.models import init_diffusion3d, run_diffusion
+from implicitglobalgrid_tpu.utils.exceptions import (
+    IncoherentArgumentError, InvalidArgumentError,
+)
+
+
+def _stacked_from_global_index(n, k, dims, periods, fn):
+    """Host-built stacked array whose every cell is ``fn(gx, gy, gz)`` of
+    its INTEGER global-grid index — the same float lands at the same
+    physical position no matter which overlap width maps it, so two
+    decompositions of one implicit grid start bit-identical.
+    (Coordinate-based ICs cannot guarantee this: different
+    ``ix + coord*(n-ol)`` float groupings of one global position round
+    ~1 ulp apart, especially through the periodic wrap.)
+
+    Per dim: ``g = ix + b*(n-ol)``; periodic dims shift by ONE ghost cell
+    (the gather/x_g convention, reference `tools.jl:102-104` — independent
+    of halowidth) and WRAP ``g`` mod the global size, so halo cells carry
+    exactly the values of the interior cells they mirror."""
+    ol = 2 * k
+    n = tuple(n) if isinstance(n, (tuple, list)) else (n,) * 3
+    S = np.zeros(tuple(d * m for d, m in zip(dims, n)))
+
+    def gidx(b, d):
+        g = np.arange(n[d]) + b * (n[d] - ol)
+        if periods[d]:
+            g = (g - 1) % (dims[d] * (n[d] - ol))
+        return g
+
+    for bx in range(dims[0]):
+        for by in range(dims[1]):
+            for bz in range(dims[2]):
+                S[bx * n[0]:(bx + 1) * n[0], by * n[1]:(by + 1) * n[1],
+                  bz * n[2]:(bz + 1) * n[2]] = fn(
+                      gidx(bx, 0)[:, None, None],
+                      gidx(by, 1)[None, :, None],
+                      gidx(bz, 2)[None, None, :])
+    return S
+
+
+def _run(local_n, k, nt, periods, dims=(2, 2, 2)):
+    """Run nt steps with exchange cadence k (halowidth k, overlap 2k)."""
+    ln = (tuple(local_n) if isinstance(local_n, (tuple, list))
+          else (local_n,) * 3)
+    igg.init_global_grid(ln[0], ln[1], ln[2],
+                         dimx=dims[0], dimy=dims[1], dimz=dims[2],
+                         periodx=periods[0], periody=periods[1],
+                         periodz=periods[2],
+                         overlaps=(2 * k,) * 3, halowidths=(k,) * 3,
+                         quiet=True)
+    try:
+        _, _, p = init_diffusion3d(dtype=np.float64, comm_every=k)
+        T = igg.device_put_g(_stacked_from_global_index(
+            ln, k, dims, periods,
+            lambda x, y, z: 100 * np.exp(-((x / 7.0 - 1) ** 2)
+                                         - ((y / 5.0 - 1) ** 2)
+                                         - ((z / 6.0 - 1) ** 2))))
+        Cp = igg.device_put_g(_stacked_from_global_index(
+            ln, k, dims, periods,
+            lambda x, y, z: 1.0 + np.exp(-((x / 9.0 - 1) ** 2)
+                                         - ((y / 8.0 - 1) ** 2)
+                                         - ((z / 7.0 - 1) ** 2))))
+        out = run_diffusion(T, Cp, p, nt, nt_chunk=max(k, 4 * k))
+        return np.asarray(igg.gather_interior(out))
+    finally:
+        igg.finalize_global_grid()
+
+
+# local sizes giving the SAME implicit global grid for k=1 (ol=2) and
+# k=2 (ol=4): non-periodic  dims*(n-ol)+ol,  periodic  dims*(n-ol)
+@pytest.mark.parametrize("periods,n1,n2", [
+    ((0, 0, 0), 8, 9),            # global 14³ both
+    ((1, 1, 1), 8, 10),           # global 12³ both
+    ((1, 0, 0), 8, (10, 9, 9)),   # mixed: x periodic (12), y/z walls (14)
+])
+def test_comm_every2_bitwise_equal(periods, n1, n2):
+    nt = 12
+    a = _run(n1, 1, nt, periods)
+    b = _run(n2, 2, nt, periods)
+    # mixed-period case: per-dim global sizes differ between formulas
+    assert a.shape == b.shape
+    assert np.array_equal(a, b), (
+        f"max diff {np.max(np.abs(a - b))} — deep-halo trajectory diverged")
+
+
+def test_comm_every3_bitwise_equal():
+    # k=3 (halowidth 3, overlap 6): three masked sub-steps per exchange;
+    # global 12³ needs local 2*(n-6)=12 -> n=12
+    a = _run(8, 1, 12, (1, 1, 1))
+    b = _run(12, 3, 12, (1, 1, 1))
+    assert np.array_equal(a, b)
+
+
+def test_comm_every_validation():
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2, quiet=True)
+    try:
+        T, Cp, p = init_diffusion3d(dtype=np.float64, comm_every=2)
+        # halowidth 1 grid cannot carry a 2-deep exchange
+        with pytest.raises(IncoherentArgumentError):
+            run_diffusion(T, Cp, p, 4)
+    finally:
+        igg.finalize_global_grid()
+    igg.init_global_grid(9, 9, 9, dimx=2, dimy=2, dimz=2,
+                         overlaps=(4, 4, 4), halowidths=(2, 2, 2),
+                         quiet=True)
+    try:
+        T, Cp, p = init_diffusion3d(dtype=np.float64, comm_every=2)
+        with pytest.raises(InvalidArgumentError):
+            run_diffusion(T, Cp, p, 7)      # nt not a multiple of k
+        with pytest.raises(InvalidArgumentError):
+            run_diffusion(T, Cp, p, 4, impl="pallas")
+        # the plain builders exchange every step: they must refuse the
+        # cadence instead of silently ignoring it
+        from implicitglobalgrid_tpu.models import make_run, make_step
+        with pytest.raises(InvalidArgumentError):
+            make_run(p, 2)
+        with pytest.raises(InvalidArgumentError):
+            make_step(p)
+    finally:
+        igg.finalize_global_grid()
+
+
+def test_comm_every_freshness_bound():
+    """An interior shard whose local size is below overlap + k would ship
+    one-sub-step-stale send slabs — the deep runner must refuse."""
+    igg.init_global_grid(5, 8, 8, dimx=3, dimy=1, dimz=2,
+                         overlaps=(4, 4, 4), halowidths=(2, 2, 2),
+                         quiet=True)
+    try:
+        T, Cp, p = init_diffusion3d(dtype=np.float64, comm_every=2)
+        with pytest.raises(IncoherentArgumentError):
+            run_diffusion(T, Cp, p, 4)   # n_x=5 < ol+k=6
+    finally:
+        igg.finalize_global_grid()
+
+
+def test_comm_every_halves_permutes():
+    """The collective count per PHYSICAL step drops k-fold: audit the
+    compiled super-step program — 6 permutes per super-step = 3 per
+    physical step at k=2 (vs 6 at k=1)."""
+    import jax
+
+    from implicitglobalgrid_tpu.models import make_run_deep
+
+    igg.init_global_grid(9, 9, 9, dimx=2, dimy=2, dimz=2,
+                         overlaps=(4, 4, 4), halowidths=(2, 2, 2),
+                         quiet=True)
+    try:
+        T, Cp, p = init_diffusion3d(dtype=np.float64, comm_every=2)
+        run = make_run_deep(p, 1)
+        txt = jax.jit(run).lower(T, Cp).compile().as_text()
+        n_perm = txt.count("collective-permute-start(")
+        if n_perm == 0:  # compiler naming variant
+            n_perm = txt.count(" collective-permute(")
+        # ONE 2-wide exchange per super-step: one permute pair per axis
+        assert n_perm == 6, f"expected 6 permutes per super-step, got {n_perm}"
+    finally:
+        igg.finalize_global_grid()
