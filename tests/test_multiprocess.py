@@ -126,6 +126,56 @@ _CHILD = textwrap.dedent("""
 """)
 
 
+_CHILD_DEEP = textwrap.dedent("""
+    import os, sys
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    ndev = int(sys.argv[5]) if len(sys.argv) > 5 else 4
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={ndev}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nproc, process_id=pid)
+    import numpy as np
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import init_diffusion3d, run_diffusion
+
+    # the canonical integer-global-index IC builder (same float at the
+    # same physical cell for any overlap width) — one copy of the subtle
+    # wrap math, shared with the single-process bitwise tests
+    from tests.test_comm_avoid import _stacked_from_global_index
+
+    def stacked(n, k, fn):
+        return _stacked_from_global_index(n, k, (2, 2, 2), (1, 1, 1), fn)
+
+    def run(nl, k):
+        igg.init_global_grid(nl, nl, nl, dimx=2, dimy=2, dimz=2,
+                             periodx=1, periody=1, periodz=1,
+                             overlaps=(2*k,)*3, halowidths=(k,)*3,
+                             quiet=True, init_dist=False, reorder=0)
+        _, _, p = init_diffusion3d(dtype=np.float64, comm_every=k)
+        T = igg.device_put_g(stacked(nl, k,
+            lambda x, y, z: 100*np.exp(-((x/7.0-1)**2) - ((y/5.0-1)**2)
+                                       - ((z/6.0-1)**2))))
+        Cp = igg.device_put_g(stacked(nl, k,
+            lambda x, y, z: 1.0 + np.exp(-((x/9.0-1)**2) - ((y/8.0-1)**2)
+                                         - ((z/7.0-1)**2))))
+        out = run_diffusion(T, Cp, p, 8, nt_chunk=8)
+        g = igg.gather_interior(out, root=0)
+        igg.finalize_global_grid()
+        return g
+
+    a = run(8, 1)    # global 12**3, exchange every step
+    b = run(10, 2)   # same global grid, 2-wide exchange every 2 steps
+    if pid == 0:
+        assert a.shape == b.shape == (12, 12, 12), (a.shape, b.shape)
+        assert np.array_equal(a, b), (
+            f"deep-halo diverged across processes: {np.abs(a-b).max()}")
+    print(f"MP_OK {pid}", flush=True)
+""")
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -134,9 +184,9 @@ def _free_port():
     return port
 
 
-def _run_children(tmp_path, nproc, dcn, ndev, timeout=240):
+def _run_children(tmp_path, nproc, dcn, ndev, timeout=240, child=_CHILD):
     script = tmp_path / "child.py"
-    script.write_text(_CHILD)
+    script.write_text(child)
     port = _free_port()
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["JAX_PLATFORMS"] = ""
@@ -168,6 +218,13 @@ def _run_children(tmp_path, nproc, dcn, ndev, timeout=240):
 @pytest.mark.parametrize("dcn", ["", "z"])
 def test_two_process_distributed_run(tmp_path, dcn):
     _run_children(tmp_path, 2, dcn, 4)
+
+
+def test_two_process_deep_halo_bitwise(tmp_path):
+    """comm_every=2 across REAL process boundaries: the k-wide exchange's
+    ppermutes cross the controller split, and the trajectory must still be
+    bit-identical to exchange-every-step on the same implicit grid."""
+    _run_children(tmp_path, 2, "", 4, timeout=300, child=_CHILD_DEEP)
 
 
 def test_four_process_two_dcn_axes(tmp_path):
