@@ -93,6 +93,39 @@ def test_comm_every2_bitwise_equal(periods, n1, n2):
         f"max diff {np.max(np.abs(a - b))} — deep-halo trajectory diverged")
 
 
+def test_comm_every2_2d_bitwise_equal():
+    """The 2-D step shares `_fresh_mask`/`make_run_deep` — same bitwise
+    contract on a 2x2 decomposition (4 of the pool's 8 devices)."""
+    from implicitglobalgrid_tpu.models import init_diffusion2d
+
+    def run2d(n, k, nt=8):
+        igg.init_global_grid(n, n, 1, dimx=2, dimy=2, dimz=0,
+                             periodx=1, periody=1,
+                             overlaps=(2 * k, 2 * k, 2 * k),
+                             halowidths=(k, k, k), quiet=True)
+        try:
+            import dataclasses
+
+            _, _, p = init_diffusion2d(dtype=np.float64)
+            p = dataclasses.replace(p, comm_every=k)
+            S3 = _stacked_from_global_index((n, n, 2), k, (2, 2, 1),
+                                            (1, 1, 0),
+                                            lambda x, y, z: 100 * np.exp(
+                                                -((x / 7.0 - 1) ** 2)
+                                                - ((y / 5.0 - 1) ** 2)))
+            T = igg.device_put_g(S3[:, :, 0])
+            Cp = igg.device_put_g(np.full_like(S3[:, :, 0], 2.0))
+            out = run_diffusion(T, Cp, p, nt, nt_chunk=nt)
+            return np.asarray(igg.gather_interior(out))
+        finally:
+            igg.finalize_global_grid()
+
+    a = run2d(8, 1)
+    b = run2d(10, 2)
+    assert a.shape == b.shape
+    assert np.array_equal(a, b)
+
+
 def test_comm_every3_bitwise_equal():
     # k=3 (halowidth 3, overlap 6): three masked sub-steps per exchange;
     # global 12³ needs local 2*(n-6)=12 -> n=12

@@ -44,6 +44,17 @@ def test_acoustic_example(tmp_path):
     assert "P interior" in out
 
 
+def test_advanced_modes_example(tmp_path):
+    out = _run("diffusion3D_advanced_modes.py", tmp_path)
+    # SR must beat plain bf16 against the f32 trajectory
+    errs = {m.group(1): float(m.group(2)) for m in re.finditer(
+        r"(bf16(?:_sr)?)\s+vs f32 after \d+ steps: max_rel=([0-9.e+-]+)",
+        out)}
+    assert errs["bf16_sr"] < errs["bf16"], errs
+    assert "comm_every=2" in out
+    assert "overlap[" in out
+
+
 def test_stokes_example(tmp_path):
     out = _run("stokes3D_multixpu.py", tmp_path)
     assert "PT iterations" in out
