@@ -47,65 +47,85 @@ def main() -> None:
     base = 32 if cpu else 64
     steps = 24 if cpu else 120  # physical steps per chunk window
 
-    def measure(k):
-        # same implicit global grid at both cadences (periodic:
-        # dims*(n-ol) must match): ol=2k -> n_k = base + 2(k-1)
+    def measure(k, init_fn, runner_fn, trace_exposed=False):
+        """One cadence-A/B leg: same implicit global grid at every k
+        (periodic: dims*(n-ol) must match -> n_k = base + 2(k-1)),
+        two-point windows over super-steps, optional exposed-collective
+        trace (max over planes, the bench_weak.py statistic)."""
         n = base + 2 * (k - 1)
         igg.init_global_grid(n, n, n, dimx=dims[0], dimy=dims[1],
                              dimz=dims[2], periodx=1, periody=1, periodz=1,
                              overlaps=(2 * k,) * 3, halowidths=(k,) * 3,
                              quiet=True)
         try:
-            T, Cp, p = init_diffusion3d(dtype=np.float32, comm_every=k)
+            state, p = init_fn(k)
             sup = steps // k  # super-steps per window
 
-            def runner(c):
-                return (make_run_deep(p, c) if k > 1
-                        else make_run(p, c, impl="xla"))
-
             def chunk(c):
-                igg.sync(runner(c)(T, Cp))
+                igg.sync(runner_fn(p, c, k)(*state))
 
             sec_per_super = bench_util.two_point(chunk, sup, 3 * sup)
-            # exposed-collective per physical step, off a trace of the
-            # same warmed program (max over planes, the bench_weak.py
-            # statistic)
-            exposed_ms = None
-            try:
-                run = runner(sup)
-                igg.sync(run(T, Cp))
-                with tempfile.TemporaryDirectory() as d:
-                    with igg.trace(d):
-                        igg.sync(run(T, Cp))
-                    stats = igg.overlap_stats(d)
-                if stats:
-                    exposed_ms = max(
-                        s["exposed_comm_us"] for s in stats.values()
-                    ) / steps / 1e3
-            except Exception:
-                pass
-            cells = float(igg.nx_g()) * float(igg.ny_g()) * float(igg.nz_g())
-            return {
-                "k": k, "local_n": n,
-                "step_ms": sec_per_super / k * 1e3,
-                "exposed_comm_ms_per_step": exposed_ms,
-                "cell_updates_per_s": cells / (sec_per_super / k),
-            }
+            row = {"k": k, "local_n": n,
+                   "step_ms": sec_per_super / k * 1e3}
+            if trace_exposed:
+                row["exposed_comm_ms_per_step"] = None
+                try:
+                    run = runner_fn(p, sup, k)
+                    igg.sync(run(*state))
+                    with tempfile.TemporaryDirectory() as d:
+                        with igg.trace(d):
+                            igg.sync(run(*state))
+                        stats = igg.overlap_stats(d)
+                    if stats:
+                        row["exposed_comm_ms_per_step"] = max(
+                            s["exposed_comm_us"] for s in stats.values()
+                        ) / steps / 1e3
+                except Exception:
+                    pass
+                cells = (float(igg.nx_g()) * float(igg.ny_g())
+                         * float(igg.nz_g()))
+                row["cell_updates_per_s"] = cells / (sec_per_super / k)
+            return row
         finally:
             igg.finalize_global_grid()
 
-    r1 = measure(1)
-    r2 = measure(2)
+    def diff_init(k):
+        T, Cp, p = init_diffusion3d(dtype=np.float32, comm_every=k)
+        return (T, Cp), p
+
+    def diff_runner(p, c, k):
+        return make_run_deep(p, c) if k > 1 else make_run(p, c, impl="xla")
+
+    from implicitglobalgrid_tpu.models import (
+        init_acoustic3d, make_acoustic_run, make_acoustic_run_deep,
+    )
+
+    def ac_init(k):
+        return init_acoustic3d(dtype=np.float32, comm_every=k)
+
+    def ac_runner(p, c, k):
+        return (make_acoustic_run_deep(p, c) if k > 1
+                else make_acoustic_run(p, c, impl="xla"))
+
+    r1 = measure(1, diff_init, diff_runner, trace_exposed=True)
+    r2 = measure(2, diff_init, diff_runner, trace_exposed=True)
+    a1 = measure(1, ac_init, ac_runner)
+    a2 = measure(2, ac_init, ac_runner)
     bench_util.emit({
         "metric": "comm_avoid_speedup",
         "value": r1["step_ms"] / r2["step_ms"],
         "unit": "step_ms(k=1)/step_ms(k=2), same global grid",
         "k1": r1,
         "k2": r2,
+        "acoustic_k1": a1,
+        "acoustic_k2": a2,
+        "acoustic_speedup": a1["step_ms"] / a2["step_ms"],
         "note": ("deep-halo stepping: k-wide exchange every k steps — "
-                 "same wire bytes, 1/k collectives; trajectories "
-                 "bit-identical (tests/test_comm_avoid.py); small-block "
-                 "latency-bound config on purpose"),
+                 "same wire bytes, 1/k collectives (for the leapfrog one "
+                 "4-field round replaces the base scheme's 2k per-step "
+                 "V + P rounds); trajectories bit-identical "
+                 "(tests/test_comm_avoid.py); small-block latency-bound "
+                 "config on purpose"),
     })
 
 

@@ -9,7 +9,7 @@ from .diffusion import (
 )
 from .acoustic import (
     AcousticParams, init_acoustic3d, acoustic_step_local,
-    make_acoustic_run, run_acoustic,
+    make_acoustic_run, make_acoustic_run_deep, run_acoustic,
 )
 from .stokes import (
     StokesParams, init_stokes3d, stokes_step_local,
@@ -22,7 +22,7 @@ __all__ = [
     "make_run_sr",
     "run_diffusion",
     "AcousticParams", "init_acoustic3d", "acoustic_step_local",
-    "make_acoustic_run", "run_acoustic",
+    "make_acoustic_run", "make_acoustic_run_deep", "run_acoustic",
     "StokesParams", "init_stokes3d", "stokes_step_local",
     "make_stokes_run", "run_stokes", "stokes_residuals",
 ]

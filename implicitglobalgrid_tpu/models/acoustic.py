@@ -29,11 +29,20 @@ from ..tools import coords_g, nx_g, ny_g, nz_g
 from .common import make_state_runner, run_chunked
 
 __all__ = ["AcousticParams", "init_acoustic3d", "acoustic_step_local",
-           "make_acoustic_run", "run_acoustic"]
+           "make_acoustic_run", "make_acoustic_run_deep", "run_acoustic"]
 
 
 @dataclass(frozen=True)
 class AcousticParams:
+    """``comm_every`` enables communication-avoiding deep halos for the
+    leapfrog (see `DiffusionParams.comm_every` for the scheme): ONE
+    4-field k-wide exchange per k steps replaces the per-step V-round +
+    P-round — one collective round where the base scheme does 2k. Between exchanges the V
+    updates retreat ``j`` cells per neighbor side at sub-step j (their P
+    dependencies are j sub-steps stale) and the P update retreats
+    ``j+1`` (it needs the CURRENT sub-step's V). XLA tier; ignores
+    ``overlap``; needs ``overlaps >= 2k, halowidths = k`` grids.
+    Trajectory is bit-identical (tests/test_comm_avoid.py)."""
     rho: float      # density
     K: float        # bulk modulus
     dt: float
@@ -41,10 +50,11 @@ class AcousticParams:
     dy: float
     dz: float
     overlap: bool = False   # hide_communication for the P update
+    comm_every: int = 1
 
 
 def init_acoustic3d(*, rho=1.0, K=1.0, lx=10.0, ly=10.0, lz=10.0,
-                    dtype=None, overlap=False):
+                    dtype=None, overlap=False, comm_every=1):
     """State (P, Vx, Vy, Vz) with a Gaussian pressure pulse in the center.
     Velocities live on faces: Vx is local ``(nx+1, ny, nz)`` (staggered —
     exercised exactly like the reference's `Vx = zeros(nx+1, ...)` pattern,
@@ -69,7 +79,8 @@ def init_acoustic3d(*, rho=1.0, K=1.0, lx=10.0, ly=10.0, lz=10.0,
     Vy = zeros_g((nx, ny + 1, nz), dtype=dtype)
     Vz = zeros_g((nx, ny, nz + 1), dtype=dtype)
     return (P, Vx, Vy, Vz), AcousticParams(
-        rho=rho, K=K, dt=dt, dx=dx, dy=dy, dz=dz, overlap=overlap)
+        rho=rho, K=K, dt=dt, dx=dx, dy=dy, dz=dz, overlap=overlap,
+        comm_every=comm_every)
 
 
 def acoustic_step_local(state, p: AcousticParams, impl: str = "xla"):
@@ -121,6 +132,57 @@ def acoustic_step_local(state, p: AcousticParams, impl: str = "xla"):
     return (P, Vx, Vy, Vz)
 
 
+def make_acoustic_run_deep(p: AcousticParams, nt_chunk_super: int):
+    """Deep-halo leapfrog runner: ONE super-step = ``p.comm_every``
+    masked sub-steps + ONE 4-field k-wide exchange.
+
+    Sub-step ``j`` masks (neighbor sides; `common.fresh_mask`):
+    - each V field: retreat ``j`` with base offset 1 in its staggered
+      dim (the base update touches faces ``[1, n)``) and 0 elsewhere —
+      its P dependencies are ``j`` sub-steps stale;
+    - P: retreat ``j+1`` with base 0 (the base update touches every
+      cell) — it consumes THIS sub-step's V, whose faces have retreated
+      ``j+1`` in the staggered dim.
+    The skipped bands (<= k wide after k sub-steps) are exactly what the
+    k-wide exchange overwrites."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .common import fresh_mask, make_state_runner, validate_deep_halo
+
+    check_initialized()
+    gg = global_grid()
+    k = int(p.comm_every)
+    validate_deep_halo(gg, 3, k)
+
+    def dP(A, d):
+        n = A.shape[d]
+        return (lax.slice_in_dim(A, 1, n, axis=d)
+                - lax.slice_in_dim(A, 0, n - 1, axis=d))
+
+    def step(state):
+        P, Vx, Vy, Vz = state
+        for j in range(k):
+            Vn = [Vx.at[1:-1, :, :].add(-p.dt / p.rho * dP(P, 0) / p.dx),
+                  Vy.at[:, 1:-1, :].add(-p.dt / p.rho * dP(P, 1) / p.dy),
+                  Vz.at[:, :, 1:-1].add(-p.dt / p.rho * dP(P, 2) / p.dz)]
+            if j:
+                Vn = [jnp.where(fresh_mask(
+                          Vn[s].shape, j,
+                          tuple(1 if d == s else 0 for d in range(3)),
+                          tuple(1 if d == s else 0 for d in range(3))),
+                          Vn[s], (Vx, Vy, Vz)[s]) for s in range(3)]
+            Vx, Vy, Vz = Vn
+            Pn = P - p.dt * p.K * (dP(Vx, 0) / p.dx + dP(Vy, 1) / p.dy
+                                   + dP(Vz, 2) / p.dz)
+            P = jnp.where(fresh_mask(P.shape, j + 1, (0, 0, 0), (0, 0, 0)),
+                          Pn, P)
+        return local_update_halo(P, Vx, Vy, Vz)
+
+    return make_state_runner(step, (3, 3, 3, 3), nt_chunk=nt_chunk_super,
+                             key=("acoustic3d_deep", p))
+
+
 def _resolve_impl(impl):
     from .common import resolve_pallas_impl
 
@@ -129,6 +191,13 @@ def _resolve_impl(impl):
 
 def make_acoustic_run(p: AcousticParams, nt_chunk: int,
                       impl: str | None = None):
+    if p.comm_every > 1:
+        from ..utils.exceptions import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"AcousticParams(comm_every={p.comm_every}) needs the "
+            "deep-halo runner: use run_acoustic or make_acoustic_run_deep "
+            "(make_acoustic_run exchanges every step).")
     impl = _resolve_impl(impl)
     return make_state_runner(
         lambda s: acoustic_step_local(s, p, impl), (3, 3, 3, 3),
@@ -139,6 +208,20 @@ def make_acoustic_run(p: AcousticParams, nt_chunk: int,
 
 def run_acoustic(state, p: AcousticParams, nt: int, *, nt_chunk: int = 100,
                  impl: str | None = None):
+    if p.comm_every > 1:
+        from ..utils.exceptions import InvalidArgumentError
+
+        k = int(p.comm_every)
+        if impl is not None and not impl.startswith("xla"):
+            raise InvalidArgumentError(
+                f"impl={impl!r} is incompatible with comm_every={k}: "
+                "deep-halo stepping currently runs only the XLA tier.")
+        if nt % k:
+            raise InvalidArgumentError(
+                f"nt={nt} must be a multiple of comm_every={k} (the "
+                "exchange cadence defines the trajectory).")
+        return run_chunked(lambda c: make_acoustic_run_deep(p, c), state,
+                           nt // k, max(1, nt_chunk // k))
     impl = _resolve_impl(impl)
     return run_chunked(lambda c: make_acoustic_run(p, c, impl), state, nt,
                        nt_chunk)

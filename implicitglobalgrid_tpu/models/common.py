@@ -13,7 +13,7 @@ from ..ops.fields import field_partition_spec
 from ..parallel.topology import check_initialized, global_grid
 
 __all__ = ["make_state_runner", "run_chunked", "default_check_vma",
-           "resolve_pallas_impl"]
+           "resolve_pallas_impl", "fresh_mask", "validate_deep_halo"]
 
 _runner_cache: dict = {}
 
@@ -42,6 +42,67 @@ def default_check_vma(step_uses_pallas: bool = False) -> bool:
     from ..ops.halo import halo_may_use_pallas
 
     return not (step_uses_pallas or halo_may_use_pallas())
+
+
+def fresh_mask(shape, retreat, base_lo, base_hi):
+    """Update-region mask for communication-avoiding deep-halo sub-steps
+    (True = this cell's stencil dependencies are fresh).
+
+    Per dim ``d``: ``[base_lo[d] + retreat·L, n_d - base_hi[d] -
+    retreat·R)`` where L/R flag a neighbor on that side of THIS shard
+    (`lax.axis_index` per mesh axis — one SPMD program serves edge and
+    interior shards; periodic sides always have a neighbor, incl. self).
+    ``base_lo/hi`` encode the scheme's exchange-fresh update region
+    (diffusion interior: 1/1; a face-staggered dim: 1/1; a full-array
+    update: 0/0); ``retreat`` is how many sub-steps of staleness the
+    field's dependencies have accumulated. The skipped cells keep stale
+    values and are overwritten by the next k-wide exchange — which is why
+    deep-halo trajectories stay bit-identical (tests/test_comm_avoid.py).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..parallel.topology import AXIS_NAMES, global_grid
+
+    gg = global_grid()
+    m = None
+    for d in range(len(shape)):
+        idx = lax.axis_index(AXIS_NAMES[d])
+        per = bool(int(gg.periods[d]))
+        has_l = jnp.logical_or(idx > 0, per)
+        has_r = jnp.logical_or(idx < int(gg.dims[d]) - 1, per)
+        i = jnp.arange(shape[d])
+        lo = base_lo[d] + jnp.where(has_l, retreat, 0)
+        hi = shape[d] - base_hi[d] - jnp.where(has_r, retreat, 0)
+        md = (i >= lo) & (i < hi)
+        md = md.reshape([-1 if dd == d else 1
+                         for dd in range(len(shape))])
+        m = md if m is None else m & md
+    return m
+
+
+def validate_deep_halo(gg, ndim: int, k: int) -> None:
+    """Shared `comm_every` coherence checks: every exchanging dim needs
+    halo depth >= k AND local size >= overlap + k (the send slabs must
+    stay inside the LAST sub-step's freshly-updated region, or an
+    interior shard silently ships one-sub-step-stale values)."""
+    from ..utils.exceptions import IncoherentArgumentError
+
+    for d in range(ndim):
+        exchanging = int(gg.dims[d]) > 1 or int(gg.periods[d])
+        if not exchanging:
+            continue
+        if int(gg.halowidths[d]) < k:
+            raise IncoherentArgumentError(
+                f"comm_every={k} needs halowidths[{d}] >= {k} on every "
+                f"exchanging dim (got {int(gg.halowidths[d])}): init the "
+                f"grid with overlaps >= {2 * k} and halowidths=({k},...).")
+        n_d, ol_d = int(gg.nxyz[d]), int(gg.overlaps[d])
+        if n_d < ol_d + k:
+            raise IncoherentArgumentError(
+                f"comm_every={k} needs local size >= overlap + {k} on "
+                f"dim {d} (got n={n_d}, overlap={ol_d}): the send slabs "
+                "would leave the freshly-updated region.")
 
 
 def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,

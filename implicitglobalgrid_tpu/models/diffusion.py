@@ -105,33 +105,13 @@ def _upd2(Tb, Cpb, p: DiffusionParams):
 
 
 def _fresh_mask(shape, j: int, gg):
-    """Cells whose radius-1 dependencies are fresh at deep-halo sub-step
-    ``j`` (True = apply the update): per dim, ``[1 + j·L, n-1 - j·R)``
-    where L/R flag a neighbor on that side of THIS shard — `lax.axis_index`
-    per mesh axis, so one SPMD program serves edge and interior shards
-    (periodic sides always have a neighbor, incl. self). The skipped
-    halo-band cells keep stale values; the next k-wide exchange overwrites
-    exactly those cells with the neighbor's fresh copies, which is why the
-    interior trajectory matches comm_every=1 bit-for-bit."""
-    import jax.numpy as jnp
-    from jax import lax
+    """Diffusion's deep-halo sub-step mask: the interior update retreats
+    ``j`` cells per neighbor side — ``[1 + j·L, n-1 - j·R)`` per dim (see
+    `common.fresh_mask` for the shared machinery and the soundness
+    argument)."""
+    from .common import fresh_mask
 
-    from ..parallel.topology import AXIS_NAMES
-
-    m = None
-    for d in range(len(shape)):
-        idx = lax.axis_index(AXIS_NAMES[d])
-        per = bool(int(gg.periods[d]))
-        has_l = jnp.logical_or(idx > 0, per)
-        has_r = jnp.logical_or(idx < int(gg.dims[d]) - 1, per)
-        i = jnp.arange(shape[d])
-        lo = 1 + jnp.where(has_l, j, 0)
-        hi = shape[d] - 1 - jnp.where(has_r, j, 0)
-        md = (i >= lo) & (i < hi)
-        md = md.reshape([-1 if dd == d else 1
-                         for dd in range(len(shape))])
-        m = md if m is None else m & md
-    return m
+    return fresh_mask(shape, j, (1,) * len(shape), (1,) * len(shape))
 
 
 def init_diffusion3d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, lz=10.0,
@@ -415,30 +395,12 @@ def make_run_deep(p: DiffusionParams, nt_chunk_super: int, ndim: int = 3):
     ``nt_chunk_super`` counts super-steps (physical steps / k)."""
     import jax.numpy as jnp
 
-    from ..utils.exceptions import IncoherentArgumentError
-    from .common import make_state_runner
+    from .common import make_state_runner, validate_deep_halo
 
     check_initialized()
     gg = global_grid()
     k = int(p.comm_every)
-    for d in range(ndim):
-        exchanging = int(gg.dims[d]) > 1 or int(gg.periods[d])
-        if exchanging and int(gg.halowidths[d]) < k:
-            raise IncoherentArgumentError(
-                f"comm_every={k} needs halowidths[{d}] >= {k} on every "
-                f"exchanging dim (got {int(gg.halowidths[d])}): init the "
-                f"grid with overlaps >= {2 * k} and halowidths=({k},...).")
-        # freshness bound: the right-send slab starts at n-ol and every
-        # sent cell must lie inside the LAST sub-step's updated region
-        # [k, n-k) — n >= ol + k, or an interior shard ships a value one
-        # sub-step stale and the bit-identical guarantee silently breaks
-        n_d = int(gg.nxyz[d])
-        ol_d = int(gg.overlaps[d])
-        if exchanging and n_d < ol_d + k:
-            raise IncoherentArgumentError(
-                f"comm_every={k} needs local size >= overlap + {k} on "
-                f"dim {d} (got n={n_d}, overlap={ol_d}): the send slabs "
-                "would leave the freshly-updated region.")
+    validate_deep_halo(gg, ndim, k)
 
     upd = _upd3 if ndim == 3 else _upd2
 
