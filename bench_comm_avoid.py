@@ -65,8 +65,11 @@ def main() -> None:
                 igg.sync(runner_fn(p, c, k)(*state))
 
             sec_per_super = bench_util.two_point(chunk, sup, 3 * sup)
+            cells = (float(igg.nx_g()) * float(igg.ny_g())
+                     * float(igg.nz_g()))
             row = {"k": k, "local_n": n,
-                   "step_ms": sec_per_super / k * 1e3}
+                   "step_ms": sec_per_super / k * 1e3,
+                   "cell_updates_per_s": cells / (sec_per_super / k)}
             if trace_exposed:
                 row["exposed_comm_ms_per_step"] = None
                 try:
@@ -82,9 +85,6 @@ def main() -> None:
                         ) / steps / 1e3
                 except Exception:
                     pass
-                cells = (float(igg.nx_g()) * float(igg.ny_g())
-                         * float(igg.nz_g()))
-                row["cell_updates_per_s"] = cells / (sec_per_super / k)
             return row
         finally:
             igg.finalize_global_grid()

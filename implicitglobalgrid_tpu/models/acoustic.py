@@ -138,7 +138,8 @@ def make_acoustic_run_deep(p: AcousticParams, nt_chunk_super: int):
 
     Sub-step ``j`` masks (neighbor sides; `common.fresh_mask`):
     - each V field: retreat ``j`` with base offset 1 in its staggered
-      dim (the base update touches faces ``[1, n)``) and 0 elsewhere —
+      dim (of its n+1 faces the base update touches ``[1, n)`` —
+      ``at[1:-1]``, so base_hi=1 off the n+1 length) and 0 elsewhere —
       its P dependencies are ``j`` sub-steps stale;
     - P: retreat ``j+1`` with base 0 (the base update touches every
       cell) — it consumes THIS sub-step's V, whose faces have retreated

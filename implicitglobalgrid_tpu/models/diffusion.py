@@ -104,7 +104,7 @@ def _upd2(Tb, Cpb, p: DiffusionParams):
     return Tb.at[1:-1, 1:-1].add(p.dt * dTdt)
 
 
-def _fresh_mask(shape, j: int, gg):
+def _fresh_mask(shape, j: int):
     """Diffusion's deep-halo sub-step mask: the interior update retreats
     ``j`` cells per neighbor side — ``[1 + j·L, n-1 - j·R)`` per dim (see
     `common.fresh_mask` for the shared machinery and the soundness
@@ -409,7 +409,7 @@ def make_run_deep(p: DiffusionParams, nt_chunk_super: int, ndim: int = 3):
         for j in range(k):
             Tn = upd(T, Cp, p)
             if j:
-                T = jnp.where(_fresh_mask(T.shape, j, gg), Tn, T)
+                T = jnp.where(_fresh_mask(T.shape, j), Tn, T)
             else:
                 T = Tn  # sub-step 0 updates the full interior
         return local_update_halo(T), Cp
