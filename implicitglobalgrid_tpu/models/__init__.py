@@ -13,7 +13,8 @@ from .acoustic import (
 )
 from .stokes import (
     StokesParams, init_stokes3d, stokes_step_local,
-    make_stokes_run, run_stokes, stokes_residuals,
+    make_stokes_run, make_stokes_run_deep, run_stokes,
+    stokes_residuals,
 )
 
 __all__ = [
@@ -24,5 +25,6 @@ __all__ = [
     "AcousticParams", "init_acoustic3d", "acoustic_step_local",
     "make_acoustic_run", "make_acoustic_run_deep", "run_acoustic",
     "StokesParams", "init_stokes3d", "stokes_step_local",
-    "make_stokes_run", "run_stokes", "stokes_residuals",
+    "make_stokes_run", "make_stokes_run_deep", "run_stokes",
+    "stokes_residuals",
 ]

@@ -81,26 +81,33 @@ def fresh_mask(shape, retreat, base_lo, base_hi):
     return m
 
 
-def validate_deep_halo(gg, ndim: int, k: int) -> None:
-    """Shared `comm_every` coherence checks: every exchanging dim needs
-    halo depth >= k AND local size >= overlap + k (the send slabs must
+def validate_deep_halo(gg, ndim: int, k: int, depth_per_step: int = 1
+                       ) -> None:
+    """Shared `comm_every` coherence checks. ``depth_per_step`` is the
+    scheme's per-sub-step dependency radius — 1 for radius-1 stencils
+    (diffusion, the acoustic leapfrog), 2 for the Stokes PT iteration
+    (V needs stresses which need V: the band retreats 2 cells per
+    iteration). Every exchanging dim needs halo depth >= depth_per_step·k
+    AND local size >= overlap + depth_per_step·k (the send slabs must
     stay inside the LAST sub-step's freshly-updated region, or an
     interior shard silently ships one-sub-step-stale values)."""
     from ..utils.exceptions import IncoherentArgumentError
 
+    need = depth_per_step * k
     for d in range(ndim):
         exchanging = int(gg.dims[d]) > 1 or int(gg.periods[d])
         if not exchanging:
             continue
-        if int(gg.halowidths[d]) < k:
+        if int(gg.halowidths[d]) < need:
             raise IncoherentArgumentError(
-                f"comm_every={k} needs halowidths[{d}] >= {k} on every "
+                f"comm_every={k} needs halowidths[{d}] >= {need} on every "
                 f"exchanging dim (got {int(gg.halowidths[d])}): init the "
-                f"grid with overlaps >= {2 * k} and halowidths=({k},...).")
+                f"grid with overlaps >= {2 * need} and "
+                f"halowidths=({need},...).")
         n_d, ol_d = int(gg.nxyz[d]), int(gg.overlaps[d])
-        if n_d < ol_d + k:
+        if n_d < ol_d + need:
             raise IncoherentArgumentError(
-                f"comm_every={k} needs local size >= overlap + {k} on "
+                f"comm_every={k} needs local size >= overlap + {need} on "
                 f"dim {d} (got n={n_d}, overlap={ol_d}): the send slabs "
                 "would leave the freshly-updated region.")
 

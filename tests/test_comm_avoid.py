@@ -174,6 +174,68 @@ def test_comm_every2_acoustic_bitwise_equal(periods, n1, n2):
             f"{name} diverged: max {np.max(np.abs(fa - fb))}")
 
 
+@pytest.mark.parametrize("periods,n1,n2", [
+    ((1, 1, 1), 9, 15),   # global 14³ both (deep grid: ol=8, hw=4)
+    ((0, 0, 0), 9, 12),   # global 16³ both
+])
+def test_comm_every2_stokes_equal(periods, n1, n2):
+    """Deep halos for the PT STOKES iteration: dependency radius 2 per
+    iteration (V ← stresses ← V), so k=2 runs on a halowidth-4 grid and
+    the super-step exchange carries 7 fields incl. the damped dV state.
+
+    Contract (see `StokesParams` docstring): all evolving fields agree
+    to <= 1e-12 relative (measured ~1e-17..1e-16). The residual is ~1
+    ulp at a handful of vector-lane-boundary positions on XLA:CPU — the
+    masked scheme substitutes a locally computed cell for the exchanged
+    copy of the same physical cell, which the CPU backend's loop
+    epilogues round 1 ulp apart on this model's long expression chain
+    (the k=1 degenerate deep runner IS bit-exact vs the base scheme, and
+    one super-step pair keeps P bit-exact, so the scheme itself is
+    sound; the ulps feed P over longer horizons)."""
+    from implicitglobalgrid_tpu.models import init_stokes3d, run_stokes
+
+    def run(n, k, nt=6):
+        hw = 2 * k if k > 1 else 1
+        igg.init_global_grid(n, n, n, dimx=2, dimy=2, dimz=2,
+                             periodx=periods[0], periody=periods[1],
+                             periodz=periods[2],
+                             overlaps=(2 * hw,) * 3, halowidths=(hw,) * 3,
+                             quiet=True)
+        try:
+            state, p = init_stokes3d(dtype=np.float64, comm_every=k)
+            rhog = igg.device_put_g(_stacked_from_global_index(
+                n, hw, (2, 2, 2), periods,
+                lambda x, y, z: np.exp(-((x / 6.0 - 1) ** 2)
+                                       - ((y / 5.0 - 1) ** 2)
+                                       - ((z / 7.0 - 1) ** 2))))
+            state = (*state[:7], rhog.astype(state[7].dtype))
+            out = run_stokes(state, p, nt, nt_chunk=nt)
+            return [np.asarray(igg.gather_interior(f)) for f in out]
+        finally:
+            igg.finalize_global_grid()
+
+    a = run(n1, 1)
+    b = run(n2, 2)
+    names = ("P", "Vx", "Vy", "Vz", "dVx", "dVy", "dVz", "rhog")
+    for fa, fb, name in zip(a, b, names):
+        assert fa.shape == fb.shape, (name, fa.shape, fb.shape)
+        if name.startswith("dV"):
+            # dV's HALO copies are undefined state in the base scheme (it
+            # never exchanges dV; they hold stale zeros) while the deep
+            # scheme refreshes them — and the non-periodic gather keeps a
+            # later block's halo copy at overlap positions, so gathered
+            # dV is not comparable. Its interior-face values are
+            # validated implicitly through V (V += dt_v*dV_i every
+            # iteration).
+            continue
+        if name == "rhog":
+            assert np.array_equal(fa, fb)
+        else:
+            scale = max(1e-30, np.abs(fa).max())
+            rel = np.max(np.abs(fa - fb)) / scale
+            assert rel < 1e-12, f"{name}: rel {rel:.2e} exceeds ulp budget"
+
+
 def test_comm_every_validation():
     igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2, quiet=True)
     try:
