@@ -137,17 +137,12 @@ def main() -> None:
         """Force the conservative kernel pipelines, RESTORING any
         user-set values afterwards (an A/B run like IGG_MP_HANDOFF=0
         must survive an unrelated config failure)."""
-        saved = {v: os.environ.get(v) for v in _VARIANT_VARS}
-        try:
+        from contextlib import ExitStack
+
+        with ExitStack() as stack:
             for v in _VARIANT_VARS:
-                os.environ[v] = "0"
+                stack.enter_context(_env0(v))
             yield
-        finally:
-            for v, old in saved.items():
-                if old is None:
-                    os.environ.pop(v, None)
-                else:
-                    os.environ[v] = old
 
     def part(name, fn, variants=True):
         """Guarded config: a failure in a config that runs the kernel tier
@@ -198,8 +193,21 @@ def main() -> None:
     # config with IGG_MP_HANDOFF=0 runs the pre-handoff pipeline that
     # re-DMAs the 2 overlap planes per window — the traffic model predicts
     # rate ratio (3 + 2/P)/3, and the measured pair either confirms the
-    # model or falsifies it in the committed artifact.
-    if not cpu:
+    # model or falsifies it in the committed artifact.  The off-leg runs
+    # ONLY when the headline actually exercised the handoff tier — with
+    # IGG_USE_PALLAS=0 or an ineligible shape the two legs are the
+    # identical program, and a ~1.0 ratio would falsely "falsify" the
+    # model (and burn a full hardware measurement for nothing).
+    def _handoff_active():
+        import jax as _jax
+
+        from implicitglobalgrid_tpu.ops.pallas_stencil import mp_handoff
+        return (headline is not None
+                and os.environ.get("IGG_USE_PALLAS", "1") != "0"
+                and bool(mp_handoff(_jax.ShapeDtypeStruct(
+                    (nx, nx, nx), np.float32))))
+
+    if not cpu and "headline_degraded" not in notes and _handoff_active():
         def _rate3_handoff_off():
             with _env0("IGG_MP_HANDOFF"):
                 return _rate3(nx, nt, np.float32)
@@ -336,12 +344,15 @@ def main() -> None:
         # A/B pair for the round-4 plane relay: IGG_PLANE_RELAY=0 re-reads
         # each field's [i-1] plane from HBM (15 read streams + 7 writes =
         # 22 passes vs 18 with the relay — predicted ratio 22/18).
+        # Skipped when the env already disables the relay: both legs
+        # would run the identical program and fake a ~1.0 ratio.
         def _rate_stokes_relay_off():
             with _env0("IGG_PLANE_RELAY"):
                 return _rate_stokes("pallas")
 
-        part("stokes3D_pt_relay_off_f32", _rate_stokes_relay_off,
-             variants=False)
+        if os.environ.get("IGG_PLANE_RELAY", "1") != "0":
+            part("stokes3D_pt_relay_off_f32", _rate_stokes_relay_off,
+                 variants=False)
     notes["kernel_tier"] = (
         "acoustic3D_pallas_fused_f32 / stokes3D_pt_f32 run the fused "
         "Pallas passes (pallas_wave/pallas_stokes; rate rows are "
