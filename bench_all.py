@@ -125,6 +125,15 @@ def main() -> None:
            _rate(cells, nta, t) / n_chips, "cell-updates/s/chip")
     igg.finalize_global_grid()
 
+    # --- halo coalescing A/B (2/4/8 fields) --------------------------------
+    # one packed ppermute pair per axis vs 2·N per-field permutes; the ratio
+    # trajectory starts recording with the coalescing PR. Config owned by
+    # `bench_halo.run_coalescing_ab` (shared with the standalone bench).
+    import bench_halo
+
+    for row in bench_halo.run_coalescing_ab(dims3, cpu):
+        results.append(bench_util.emit(row))
+
     # --- pseudo-transient Stokes 3-D (BASELINE config 5) -------------------
     nxs, nts = (24, 20) if cpu else (128, 300)
     igg.init_global_grid(nxs, nxs, nxs, dimx=dims3[0], dimy=dims3[1],

@@ -29,6 +29,65 @@ import sys
 import bench_util
 
 
+def coalescing_ab_rows(nx: int, c1: int, field_counts=(2, 4, 8),
+                       dtype=None):
+    """A/B rows for the coalesced vs per-field multi-field exchange.
+
+    For each field count N, times the N-field `local_update_halo` hot loop
+    with collective coalescing ON (one ppermute pair per axis) and OFF
+    (2·N permutes per axis) on the CURRENT grid, and returns one row per N
+    with ``value`` = per_field_seconds / coalesced_seconds (>1 means
+    coalescing wins; the latency-bound small-message regime it targets).
+    Caller owns grid init/finalize."""
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models.common import make_state_runner
+
+    dtype = dtype or np.float32
+    rows = []
+    for n_fields in field_counts:
+        fields = tuple(igg.ones_g((nx, nx, nx), dtype) * (i + 1)
+                       for i in range(n_fields))
+        secs = {}
+        for mode, co in (("coalesced", True), ("per_field", False)):
+            def step(s, co=co):
+                out = igg.local_update_halo(*s, coalesce=co)
+                return out if isinstance(out, tuple) else (out,)
+
+            def chunk(c):
+                run = make_state_runner(
+                    step, (3,) * n_fields, nt_chunk=c,
+                    key=("bench_halo_ab", mode, n_fields, nx, str(dtype)))
+                igg.sync(run(*fields))
+
+            secs[mode] = bench_util.two_point(chunk, c1, 3 * c1)
+        rows.append({
+            "metric": f"update_halo_coalesced_speedup_{n_fields}fields",
+            "value": secs["per_field"] / secs["coalesced"],
+            "unit": "x (per_field_s / coalesced_s)",
+            "coalesced_s_per_call": secs["coalesced"],
+            "per_field_s_per_call": secs["per_field"],
+        })
+    return rows
+
+
+def run_coalescing_ab(dims, cpu: bool):
+    """The canonical A/B leg: init its own all-periodic grid over ``dims``,
+    measure, finalize, return the rows. Shared by this script's __main__
+    and `bench_all.py` so the config stays in ONE place."""
+    import implicitglobalgrid_tpu as igg
+
+    nx_ab, c_ab = (32, 4) if cpu else (256, 20)
+    igg.init_global_grid(nx_ab, nx_ab, nx_ab, dimx=dims[0], dimy=dims[1],
+                         dimz=dims[2], periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    try:
+        return coalescing_ab_rows(nx_ab, c_ab)
+    finally:
+        igg.finalize_global_grid()
+
+
 def main() -> None:
     cpu = "--cpu" in sys.argv
     if cpu:
@@ -80,7 +139,13 @@ def main() -> None:
         "unit": "GB/s/chip",
         "vs_baseline": gbps / 1.0,
     })
+
     igg.finalize_global_grid()
+
+    # Coalesced vs per-field A/B (2/4/8 fields) on its own grid — the
+    # multi-field leg `bench_all.py` also records into BENCH_ALL.json.
+    for row in run_coalescing_ab(dims, cpu):
+        bench_util.emit(row)
 
 
 if __name__ == "__main__":

@@ -150,9 +150,11 @@ def _checks(interpret: bool):
                 Tb, Cpb, gg, modes, lam=p.lam, dt=p.dt, dx=p.dx, dy=p.dy,
                 dz=p.dz, interpret=interpret)
 
-        fused = jax.jit(jax.shard_map(local, mesh=gg.mesh,
-                                      in_specs=(spec, spec), out_specs=spec,
-                                      check_vma=False))
+        from implicitglobalgrid_tpu.utils.compat import shard_map
+
+        fused = jax.jit(shard_map(local, mesh=gg.mesh,
+                                  in_specs=(spec, spec), out_specs=spec,
+                                  check_vma=False))
         a = np.asarray(igg.gather(run_diffusion(T, Cp, p, 1, nt_chunk=1,
                                                 impl="xla")))
         b = np.asarray(igg.gather(fused(T, Cp)))

@@ -145,11 +145,17 @@ def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
         # kernel_flags are read at TRACE time inside the kernel builders;
         # keying on them keeps the documented IGG_MP_HANDOFF /
         # IGG_PLANE_RELAY A/B flips honest within one grid epoch (no
-        # stale cached runner).
+        # stale cached runner). Same rule for the halo exchange knobs
+        # (IGG_HALO_COALESCE / IGG_HALO_WIRE_DTYPE), resolved at trace
+        # time inside `local_update_halo` calls in the step body.
+        from ..ops.halo import resolve_halo_coalesce
         from ..ops.pallas_stencil import kernel_flags
+        from ..ops.precision import resolve_wire_dtype
 
         full_key = (gg.epoch, key, tuple(state_ndims), int(nt_chunk),
-                    bool(check_vma), int(unroll), kernel_flags())
+                    bool(check_vma), int(unroll), kernel_flags(),
+                    resolve_halo_coalesce(None),
+                    str(resolve_wire_dtype(None)))
         fn = _runner_cache.get(full_key)
         if fn is not None:
             return fn
@@ -162,7 +168,9 @@ def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
                             tuple(state), unroll=unroll)
         return out
 
-    fn = jax.jit(jax.shard_map(
+    from ..utils.compat import shard_map
+
+    fn = jax.jit(shard_map(
         chunk, mesh=gg.mesh, in_specs=specs, out_specs=specs,
         check_vma=check_vma,
     ))

@@ -333,9 +333,10 @@ def make_step(p: DiffusionParams, ndim: int = 3, impl: str | None = None):
     def local(T, Cp):
         return diffusion_step_local(T, Cp, p, impl)
 
+    from ..utils.compat import shard_map
     from .common import default_check_vma
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         local, mesh=gg.mesh, in_specs=(spec, spec), out_specs=spec,
         check_vma=default_check_vma(impl.startswith("pallas")),
     ))

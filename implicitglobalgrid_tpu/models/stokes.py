@@ -319,7 +319,9 @@ def stokes_residuals(state, p: StokesParams):
             err_mom = lax.pmax(err_mom, ax)
         return err_div, err_mom
 
-    fn = jax.jit(jax.shard_map(
+    from ..utils.compat import shard_map
+
+    fn = jax.jit(shard_map(
         local, mesh=gg.mesh, in_specs=(spec,) * 8,
         out_specs=(Pspec(), Pspec())))
     _residual_cache[key] = fn

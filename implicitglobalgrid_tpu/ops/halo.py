@@ -31,6 +31,30 @@ Exchange semantics reproduced exactly (index math from
   select on the mesh coordinate (`lax.axis_index`).
 - a periodic axis with a single shard short-circuits to local slab copies
   (the reference's self-neighbor path, `update_halo.jl:62-68,363-380`).
+
+Collective coalescing (default ON; `IGG_HALO_COALESCE=0` or ``coalesce=False``
+reverts): when several fields of one dtype exchange along a ppermute axis,
+their send slabs are raveled and concatenated into ONE flat buffer per
+direction, so the axis costs a single ppermute pair REGARDLESS of field
+count — the latency-bound cost of N small collectives collapses into one
+message per link (the aggregation result of HiCCL, arXiv:2408.05962; the
+reference's analog is its multi-field pipelining note, `update_halo.jl:17`).
+Unpacking splits the flat receive buffer back into per-field slabs and
+delivers them via the multi-field Pallas kernel
+(`pallas_halo.halo_write_multi_pallas`, one launch per axis) or per-field
+`dynamic_update_slice`. Fields that cannot ride a packed exchange (lone
+dtype on an axis, non-participating dims) fall back to the per-field path;
+self-neighbor axes have no collective to coalesce and keep their local
+copies. Results are bit-identical to the per-field path
+(tests/test_update_halo.py) — packing is ravel/concat, no arithmetic.
+
+Wire precision (default OFF; `IGG_HALO_WIRE_DTYPE` / ``wire_dtype=``): f32/f64
+state optionally crosses the ICI link as a narrower float
+(convert → pack → ppermute → unpack → convert back, the EQuARX play,
+arXiv:2506.17615) — ~2x less wire traffic on bandwidth-bound exchanges, at
+reduced halo precision. Applies to every ppermute payload (coalesced or
+per-field); PROC_NULL boundary halos and self-neighbor local copies never
+round-trip through the wire dtype. See `ops.precision.wire_dtype_for`.
 """
 
 from __future__ import annotations
@@ -46,9 +70,11 @@ from ..utils.exceptions import IncoherentArgumentError, InvalidArgumentError
 from .fields import (
     Field, check_fields, extract, field_partition_spec, wrap_field,
 )
+from .precision import resolve_wire_dtype, wire_dtype_for
 
 __all__ = ["update_halo", "local_update_halo", "free_update_halo_caches",
-           "halo_may_use_pallas", "DEFAULT_DIMS_ORDER"]
+           "halo_may_use_pallas", "resolve_halo_coalesce",
+           "DEFAULT_DIMS_ORDER"]
 
 # Reference default `dims=(3,1,2)` (1-based: z, x, y — update_halo.jl:29).
 DEFAULT_DIMS_ORDER = (2, 0, 1)
@@ -93,6 +119,25 @@ def _normalize_dims_order(dims):
             "(Note: this API is 0-based; the Julia reference's default (3,1,2) is (2,0,1) here.)"
         )
     return out
+
+
+def resolve_halo_coalesce(coalesce=None) -> bool:
+    """Whether multi-field exchanges pack one ppermute pair per (axis, dtype
+    group). An explicit argument wins; else ``IGG_HALO_COALESCE`` (default
+    ON)."""
+    if coalesce is not None:
+        return bool(coalesce)
+    import os
+
+    v = os.environ.get("IGG_HALO_COALESCE")
+    if v is None:
+        return True
+    try:
+        return int(v) > 0
+    except ValueError as e:
+        raise InvalidArgumentError(
+            f"Environment variable IGG_HALO_COALESCE: expected an integer, "
+            f"got {v!r}.") from e
 
 
 def _dim_meta(gg, dim: int):
@@ -244,12 +289,7 @@ def exchange_recv_slabs(gg, shape, hws, modes, get_slab):
         if D == 1:  # periodic self-neighbor: local swap
             recv_l, recv_r = send_r, send_l
         else:
-            if periodic:
-                perm_p = [(i, (i + disp) % D) for i in range(D)]
-                perm_m = [(i, (i - disp) % D) for i in range(D)]
-            else:
-                perm_p = [(i, i + disp) for i in range(D - disp)]
-                perm_m = [(i, i - disp) for i in range(disp, D)]
+            perm_p, perm_m = _perm_pairs(D, periodic, disp)
             axis_name = AXIS_NAMES[dim]
             recv_l = lax.ppermute(send_r, axis_name, perm_p)
             recv_r = lax.ppermute(send_l, axis_name, perm_m)
@@ -297,13 +337,167 @@ def _apply_self_exchange(gg, arrays, hws, dims_order):
     return handled
 
 
-def _exchange_arrays(gg, arrays, hws, dims_order):
+def _perm_pairs(D, periodic, disp):
+    """The (forward, backward) ppermute pairs of an exchanging axis —
+    wrap-around when periodic, truncated chains (PROC_NULL edges) when not.
+    ONE copy shared by the per-field and coalesced paths so the wire
+    pattern can never diverge between them."""
+    if periodic:
+        return ([(i, (i + disp) % D) for i in range(D)],
+                [(i, (i - disp) % D) for i in range(D)])
+    if disp >= D:
+        return [], []
+    return ([(i, i + disp) for i in range(D - disp)],
+            [(i, i - disp) for i in range(disp, D)])
+
+
+def _check_slab_fit(s, dim, ol_d, hw):
+    if not (0 <= s - ol_d and ol_d - hw >= 0 and hw <= s):
+        raise IncoherentArgumentError(
+            f"Field of local size {s} along dimension {dim} cannot hold send slabs "
+            f"(overlap {ol_d}, halowidth {hw})."
+        )
+
+
+def _coalesce_groups(gg, arrays, hws, handled, dims_order):
+    """Packing plan for the coalesced exchange: ``{dim: [group, ...]}``
+    where each group is a tuple of >= 2 field indices of ONE dtype that all
+    exchange along ppermute axis ``dim`` (a lone field per dtype gains
+    nothing from packing and keeps the per-field path — the fallback the
+    packer declares by simply not grouping)."""
+    out = {}
+    for dim in dims_order:
+        D, periodic, disp = _dim_meta(gg, dim)
+        if D == 1:
+            continue  # self-neighbor / no-neighbor axes: nothing to pack
+        by_dt = {}
+        for i, a in enumerate(arrays):
+            if handled[i]:
+                continue
+            if _dim_exchanges(gg, a.shape, hws[i], dim):
+                by_dt.setdefault(np.dtype(a.dtype), []).append(i)
+        groups = [tuple(g) for g in by_dt.values() if len(g) >= 2]
+        if groups:
+            out[dim] = groups
+    return out
+
+
+def _coalesced_pallas_mode(gg, dim, shapes, hws_dim):
+    """(use_multi_kernel, interpret) for the coalesced unpack along
+    ``dim`` — the multi-field analog of `_pallas_write_mode`."""
+    from .pallas_halo import multi_write_supported
+
+    if not multi_write_supported(shapes, dim, hws_dim):
+        return False, False
+    if _FORCE_PALLAS_WRITE_INTERPRET:
+        return True, True
+    return bool(gg.use_pallas[dim]) and gg.device_type == "tpu", False
+
+
+def _exchange_dim_coalesced(gg, arrays, idxs, hws, dim, wire=None):
+    """Exchange the halos of fields ``idxs`` (one dtype) along ``dim`` with
+    ONE ppermute pair: ravel + concatenate every field's send slab into a
+    flat buffer per direction, permute, split/reshape, deliver. Mutates
+    ``arrays``. Values are bit-identical to the per-field exchange — the
+    pack stage is pure layout (and the PROC_NULL boundary select runs on
+    the packed buffer, elementwise-equal to the per-field selects)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    D, periodic, disp = _dim_meta(gg, dim)
+    axis_name = AXIS_NAMES[dim]
+    perm_p, perm_m = _perm_pairs(D, periodic, disp)
+
+    metas = []  # (i, hw, s, slab_shape, flat_size)
+    parts_r, parts_l, cur_l_parts, cur_r_parts = [], [], [], []
+    for i in idxs:
+        a = arrays[i]
+        hw = int(hws[i][dim])
+        s = a.shape[dim]
+        ol_d = int(gg.overlaps[dim] + (s - gg.nxyz[dim]))
+        _check_slab_fit(s, dim, ol_d, hw)
+        send_r = lax.slice_in_dim(a, s - ol_d, s - ol_d + hw, axis=dim)
+        send_l = lax.slice_in_dim(a, ol_d - hw, ol_d, axis=dim)
+        metas.append((i, hw, s, send_r.shape, int(np.prod(send_r.shape))))
+        parts_r.append(send_r.reshape(-1))
+        parts_l.append(send_l.reshape(-1))
+        if not periodic:  # exact-precision boundary halos (PROC_NULL no-op)
+            cur_l_parts.append(lax.slice_in_dim(a, 0, hw, axis=dim).reshape(-1))
+            cur_r_parts.append(lax.slice_in_dim(a, s - hw, s, axis=dim).reshape(-1))
+
+    flat_r = jnp.concatenate(parts_r)
+    flat_l = jnp.concatenate(parts_l)
+    wire_dt = wire_dtype_for(flat_r.dtype, wire)
+    state_dt = flat_r.dtype
+    if wire_dt is not None:
+        flat_r = flat_r.astype(wire_dt)
+        flat_l = flat_l.astype(wire_dt)
+    recv_l = lax.ppermute(flat_r, axis_name, perm_p)
+    recv_r = lax.ppermute(flat_l, axis_name, perm_m)
+    if wire_dt is not None:
+        recv_l = recv_l.astype(state_dt)
+        recv_r = recv_r.astype(state_dt)
+    if not periodic:
+        idxv = lax.axis_index(axis_name)
+        recv_l = jnp.where(idxv >= disp, recv_l, jnp.concatenate(cur_l_parts))
+        recv_r = jnp.where(idxv < D - disp, recv_r,
+                           jnp.concatenate(cur_r_parts))
+
+    off = 0
+    slab_pairs = []  # aligned with metas
+    for (_, _, _, shp, size) in metas:
+        rl = lax.slice_in_dim(recv_l, off, off + size, axis=0).reshape(shp)
+        rr = lax.slice_in_dim(recv_r, off, off + size, axis=0).reshape(shp)
+        slab_pairs.append((rl, rr))
+        off += size
+
+    use_multi, interp = _coalesced_pallas_mode(
+        gg, dim, [arrays[i].shape for i in idxs], [m[1] for m in metas])
+    if use_multi:
+        from .pallas_halo import halo_write_multi_pallas
+
+        outs = halo_write_multi_pallas(
+            [arrays[i] for i in idxs], slab_pairs,
+            dim=dim, hw=metas[0][1], interpret=interp)
+        for i, o in zip(idxs, outs):
+            arrays[i] = o
+        return
+    for (i, hw, s, _, _), (rl, rr) in zip(metas, slab_pairs):
+        pw, interp = _pallas_write_mode(gg, dim, arrays[i].shape, hw)
+        if pw:
+            from .pallas_halo import halo_write_inplace
+
+            arrays[i] = halo_write_inplace(arrays[i], rl, rr, dim=dim, hw=hw,
+                                           interpret=interp)
+        else:
+            a = lax.dynamic_update_slice_in_dim(arrays[i], rl, 0, axis=dim)
+            arrays[i] = lax.dynamic_update_slice_in_dim(a, rr, s - hw,
+                                                        axis=dim)
+
+
+def _exchange_arrays(gg, arrays, hws, dims_order, coalesce=None, wire=None):
     """Exchange every field's halos (local view; inside shard_map).
     Mutates and returns ``arrays``. Kernel-path selection per field:
-    all-self single-pass kernel > combined one-pass unpack > per-dim."""
+    all-self single-pass kernel > coalesced packed exchange (multi-field
+    dtype groups) > combined one-pass unpack > per-dim per-field.
+
+    ``coalesce=None`` resolves `resolve_halo_coalesce` (env default ON);
+    ``wire`` is the RESOLVED wire dtype (`precision.resolve_wire_dtype`)
+    or None for full-precision wire. Wire mode routes its fields through
+    the coalesced/per-dim paths (the combined one-pass tier has its own
+    full-precision permutes)."""
+    if coalesce is None:
+        coalesce = resolve_halo_coalesce(None)
     handled = _apply_self_exchange(gg, arrays, hws, dims_order)
+    groups_by_dim = _coalesce_groups(gg, arrays, hws, handled, dims_order) \
+        if coalesce else {}
+    grouped = {i for gs in groups_by_dim.values() for g in gs for i in g}
     for i, a in enumerate(arrays):
-        if handled[i]:
+        # wire-affected fields skip the combined tier (its permutes are
+        # full-precision); fields the wire dtype can never touch (ints,
+        # already-narrow floats) keep it.
+        if handled[i] or i in grouped \
+                or wire_dtype_for(a.dtype, wire) is not None:
             continue
         modes = _combined_plan(gg, a.shape, hws[i], dims_order)
         if modes is not None:
@@ -314,8 +508,12 @@ def _exchange_arrays(gg, arrays, hws, dims_order):
         D, periodic, disp = _dim_meta(gg, dim)
         if D == 1 and not periodic:
             continue  # no neighbors along this axis (reference update_halo.jl:45 note)
+        in_group = set()
+        for g in groups_by_dim.get(dim, ()):
+            in_group.update(g)
+            _exchange_dim_coalesced(gg, arrays, list(g), hws, dim, wire)
         for i, a in enumerate(arrays):
-            if handled[i] or dim >= a.ndim:
+            if handled[i] or i in in_group or dim >= a.ndim:
                 continue
             hw = int(hws[i][dim])
             ol_d = int(gg.overlaps[dim] + (a.shape[dim] - gg.nxyz[dim]))
@@ -325,29 +523,28 @@ def _exchange_arrays(gg, arrays, hws, dims_order):
             arrays[i] = _exchange_dim_local(
                 a, dim=dim, hw=hw, ol_d=ol_d, D=D, periodic=periodic,
                 disp=disp, axis_name=AXIS_NAMES[dim],
-                pallas_write=pw, interpret=interp,
+                pallas_write=pw, interpret=interp, wire=wire,
             )
     return arrays
 
 
 def _exchange_dim_local(a, *, dim, hw, ol_d, D, periodic, disp, axis_name,
-                        pallas_write=False, interpret=False):
+                        pallas_write=False, interpret=False, wire=None):
     """Exchange the halos of local block ``a`` along array axis ``dim``.
 
     Runs inside `shard_map`. All shapes/indices are static; only the mesh
     coordinate (`axis_index`) is traced. With ``pallas_write``, the unpack
     writes the halo slabs in place via the Pallas kernels (`pallas_halo.py`)
-    instead of full-array `dynamic_update_slice` rewrites.
+    instead of full-array `dynamic_update_slice` rewrites. ``wire`` is the
+    resolved wire-precision dtype: ppermute payloads cross the link
+    narrowed (`precision.wire_dtype_for`); local self-neighbor copies and
+    PROC_NULL boundary halos never do.
     """
     import jax.numpy as jnp
     from jax import lax
 
     s = a.shape[dim]
-    if not (0 <= s - ol_d and ol_d - hw >= 0 and hw <= s):
-        raise IncoherentArgumentError(
-            f"Field of local size {s} along dimension {dim} cannot hold send slabs "
-            f"(overlap {ol_d}, halowidth {hw})."
-        )
+    _check_slab_fit(s, dim, ol_d, hw)
 
     def write_halos(a, into_l, into_r):
         """Halo writes: left halo <- ``into_l``, right halo <- ``into_r``."""
@@ -372,20 +569,23 @@ def _exchange_dim_local(a, *, dim, hw, ol_d, D, periodic, disp, axis_name,
         # left halo <- own right slab, right halo <- own left slab.
         return write_halos(a, send_r, send_l)
 
-    if periodic:
-        perm_p = [(i, (i + disp) % D) for i in range(D)]
-        perm_m = [(i, (i - disp) % D) for i in range(D)]
-    else:
-        perm_p = [(i, i + disp) for i in range(D - disp)] if disp < D else []
-        perm_m = [(i, i - disp) for i in range(disp, D)] if disp < D else []
+    perm_p, perm_m = _perm_pairs(D, periodic, disp)
     if not perm_p and not perm_m:
         return a
+
+    wire_dt = wire_dtype_for(a.dtype, wire)
+    if wire_dt is not None:
+        send_r = send_r.astype(wire_dt)
+        send_l = send_l.astype(wire_dt)
 
     # Both directions posted before any consumption — the analog of the
     # reference posting all Irecv!/Isend before waiting (update_halo.jl:51-60);
     # XLA schedules the two collectives concurrently.
     recv_l = lax.ppermute(send_r, axis_name, perm_p) if perm_p else None  # from coord-disp
     recv_r = lax.ppermute(send_l, axis_name, perm_m) if perm_m else None  # from coord+disp
+    if wire_dt is not None:
+        recv_l = recv_l.astype(a.dtype) if recv_l is not None else None
+        recv_r = recv_r.astype(a.dtype) if recv_r is not None else None
 
     idx = lax.axis_index(axis_name)
     if not periodic:  # PROC_NULL edges: boundary shards keep their halos
@@ -396,7 +596,7 @@ def _exchange_dim_local(a, *, dim, hw, ol_d, D, periodic, disp, axis_name,
     return write_halos(a, recv_l, recv_r)
 
 
-def local_update_halo(*fields, dims=None):
+def local_update_halo(*fields, dims=None, coalesce=None, wire_dtype=None):
     """Halo-exchange local blocks — use INSIDE `shard_map` over the grid mesh.
 
     This is the local-view programming model of the reference (user code runs
@@ -407,7 +607,10 @@ def local_update_halo(*fields, dims=None):
 
     Arguments may be arrays or ``Field(A, halowidths)``; ``dims`` is the
     0-based dimension processing order (default z, x, y like the reference's
-    `(3,1,2)`).
+    `(3,1,2)`). ``coalesce`` packs multi-field exchanges into one ppermute
+    pair per (axis, dtype group) — default from ``IGG_HALO_COALESCE`` (ON);
+    ``wire_dtype`` ships float payloads across the link narrowed — default
+    from ``IGG_HALO_WIRE_DTYPE`` (OFF); see the module docstring.
 
     NOTE: on a default TPU grid this emits Pallas kernels (in-place halo
     writes / single-pass self-exchange), which cannot pass `shard_map`'s
@@ -420,13 +623,18 @@ def local_update_halo(*fields, dims=None):
     dims_order = _normalize_dims_order(dims)
     fs = [wrap_field(f) for f in fields]
     arrays = _exchange_arrays(gg, [f.A for f in fs],
-                              [f.halowidths for f in fs], dims_order)
+                              [f.halowidths for f in fs], dims_order,
+                              coalesce=resolve_halo_coalesce(coalesce),
+                              wire=resolve_wire_dtype(wire_dtype))
     return arrays[0] if len(arrays) == 1 else tuple(arrays)
 
 
-def _build_exchange_fn(gg, sig, dims_order):
-    """Compile the jitted shard_map exchange program for a field signature."""
+def _build_exchange_fn(gg, sig, dims_order, coalesce, wire):
+    """Compile the jitted shard_map exchange program for a field signature.
+    ``coalesce`` and ``wire`` are pre-resolved (`update_halo`)."""
     import jax
+
+    from ..utils.compat import shard_map
 
     ndims_arr = [len(shape) for (shape, _, _) in sig]
     in_specs = tuple(field_partition_spec(nd) for nd in ndims_arr)
@@ -434,7 +642,10 @@ def _build_exchange_fn(gg, sig, dims_order):
 
     # Pallas kernels under shard_map require check_vma=False (their outputs
     # can't express the mesh-axis variance the checker wants — same rule as
-    # the model step kernels, models/diffusion.py).
+    # the model step kernels, models/diffusion.py). The per-field plans are
+    # a superset of the coalesced path's kernel gates (`multi_write_supported`
+    # is strictly tighter than per-field `halo_write_supported`), so this
+    # stays correct when coalescing reroutes fields.
     any_pallas = any(
         _self_exchange_plan(gg, shape, hw, dims_order) is not None
         or _combined_plan(gg, shape, hw, dims_order) is not None
@@ -447,16 +658,17 @@ def _build_exchange_fn(gg, sig, dims_order):
     )
 
     def exchange(*locals_):
-        return tuple(_exchange_arrays(gg, list(locals_), hws, dims_order))
+        return tuple(_exchange_arrays(gg, list(locals_), hws, dims_order,
+                                      coalesce=coalesce, wire=wire))
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         exchange, mesh=gg.mesh, in_specs=in_specs, out_specs=in_specs,
         check_vma=not any_pallas,
     )
     return jax.jit(shmapped)
 
 
-def update_halo(*fields, dims=None):
+def update_halo(*fields, dims=None, coalesce=None, wire_dtype=None):
     """Update the halo of the given global (stacked) array(s).
 
     Controller-side API of the reference's `update_halo!`
@@ -470,9 +682,12 @@ def update_halo(*fields, dims=None):
 
     Fields may be arrays, ``Field(A, halowidths)``, ``(A, halowidths)`` tuples,
     or pytrees of arrays (the CellArray analog, reference `shared.jl:133-137`).
-    Group several fields in one call for best performance — all their permutes
-    compile into one program and pipeline (reference performance note,
-    `update_halo.jl:17-18`).
+    Group several fields in one call for best performance — same-dtype fields
+    COALESCE into one ppermute pair per mesh axis (``coalesce``, default from
+    ``IGG_HALO_COALESCE``: ON), the stronger form of the reference's
+    multi-field pipelining note (`update_halo.jl:17-18`). ``wire_dtype``
+    (default from ``IGG_HALO_WIRE_DTYPE``: OFF) ships float payloads across
+    the link at reduced precision; see the module docstring.
 
     Example (doctest):
 
@@ -532,10 +747,13 @@ def update_halo(*fields, dims=None):
         )
         for a, f in zip(arrays, fs)
     )
-    key = (grid_epoch(), sig, dims_order, _FORCE_PALLAS_WRITE_INTERPRET)
+    coalesce_r = resolve_halo_coalesce(coalesce)
+    wire_r = resolve_wire_dtype(wire_dtype)
+    key = (grid_epoch(), sig, dims_order, _FORCE_PALLAS_WRITE_INTERPRET,
+           coalesce_r, str(wire_r))
     fn = _exchange_cache.get(key)
     if fn is None:
-        fn = _build_exchange_fn(gg, sig, dims_order)
+        fn = _build_exchange_fn(gg, sig, dims_order, coalesce_r, wire_r)
         _exchange_cache[key] = fn
     out = fn(*arrays)
     return out[0] if len(out) == 1 else tuple(out)

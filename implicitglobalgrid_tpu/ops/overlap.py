@@ -42,9 +42,14 @@ def _exchanged_dims(gg, a_ndim, dims_order):
 
 
 def hide_communication(update_fn, T, *aux, radius: int = 1, dims=None,
-                       halowidths=None):
+                       halowidths=None, coalesce=None, wire_dtype=None):
     """One overlapped step on a LOCAL block (use inside `shard_map`):
     ``T_new = hide_communication(update_fn, T, Cp, ...)``.
+
+    ``coalesce``/``wire_dtype`` forward to the embedded exchange
+    (`local_update_halo`; defaults resolve from ``IGG_HALO_COALESCE`` /
+    ``IGG_HALO_WIRE_DTYPE``) — a wire-precision run keeps its reduced
+    wire format through the overlapped step.
 
     ``update_fn(T_block, *aux_blocks) -> T_block_updated`` must be a pure
     local stencil of radius ``radius`` in ``T``: it may update only cells
@@ -90,14 +95,13 @@ def hide_communication(update_fn, T, *aux, radius: int = 1, dims=None,
             for a, st in zip(arrays, stags)
         )
 
+    def exchange(U):
+        f = U if halowidths is None else {"A": U, "halowidths": halowidths}
+        return local_update_halo(f, dims=dims_order, coalesce=coalesce,
+                                 wire_dtype=wire_dtype)
+
     def plain_fallback():
-        U = update_fn(T, *aux)
-        if halowidths is not None:
-            U = local_update_halo({"A": U, "halowidths": halowidths},
-                                  dims=dims_order)
-        else:
-            U = local_update_halo(U, dims=dims_order)
-        return U
+        return exchange(update_fn(T, *aux))
 
     arrays = (T,) + aux
     all_stags = [(0,) * T.ndim] + staggers
@@ -121,9 +125,7 @@ def hide_communication(update_fn, T, *aux, radius: int = 1, dims=None,
         interior_lohi[d] = (ol_d, s - ol_d)
 
     # (2) exchange: depends only on the shell slabs.
-    exchanged = local_update_halo(shell, dims=dims_order) if halowidths is None \
-        else local_update_halo({"A": shell, "halowidths": halowidths},
-                               dims=dims_order)
+    exchanged = exchange(shell)
 
     # (3) interior: input = interior grown by r in exchanged dims.
     int_in, int_stags = arrays, all_stags

@@ -36,7 +36,8 @@ from functools import partial
 
 __all__ = ["halo_write_supported", "halo_write_inplace",
            "self_exchange_supported", "halo_self_exchange_pallas",
-           "combined_write_supported", "halo_write_combined_pallas"]
+           "combined_write_supported", "halo_write_combined_pallas",
+           "multi_write_supported", "halo_write_multi_pallas"]
 
 _SUBLANE = 8
 _LANE = 128
@@ -159,6 +160,133 @@ def _rmw_kernel(s_ref, a_ref, o_ref, *, dim, hw, strip):
     left = j == 0  # scalar-predicate select over bool vectors won't legalize
     mask = (left & (pos < hw)) | (~left & (pos >= strip - hw))
     o_ref[0] = jnp.where(mask, sl, cur)
+
+
+# ---------------------------------------------------------------------------
+# Multi-field unpack: the delivery stage of the COALESCED exchange
+# (`ops.halo._exchange_dim_coalesced`). After the per-axis packed ppermute
+# pair, every participating field's received slabs are written into its halo
+# regions by ONE pallas_call — one kernel launch per (axis, dtype group)
+# instead of one per field, with the same in-place slab-sized traffic as
+# `halo_write_inplace` (all field buffers aliased input->output).
+# ---------------------------------------------------------------------------
+
+def multi_write_supported(shapes, dim: int, hws_dim) -> bool:
+    """Whether `halo_write_multi_pallas` can deliver along ``dim`` for
+    fields of these local ``shapes``: every field passes the single-field
+    gate (`halo_write_supported` — 3-D, dims 0/1 only), all fields share
+    the halowidth along ``dim`` (it sizes the shared pallas grid), and for
+    the dim-1 strip RMW all fields share the plane count ``shape[0]``."""
+    hws_dim = [int(h) for h in hws_dim]
+    if len(set(hws_dim)) != 1:
+        return False
+    hw = hws_dim[0]
+    if not all(halo_write_supported(s, dim, hw) for s in shapes):
+        return False
+    if dim == 1 and len({int(s[0]) for s in shapes}) != 1:
+        return False
+    return True
+
+
+def _dim0_multi_out_map(i, *, s, hw):
+    import jax.numpy as jnp
+
+    return (jnp.where(i < hw, i, s - 2 * hw + i), 0, 0)
+
+
+def halo_write_multi_pallas(arrays, slab_pairs, *, dim: int, hw: int,
+                            interpret: bool = False):
+    """Write EVERY field's ``(slab_l, slab_r)`` halos along ``dim`` in one
+    pallas_call (gate: `multi_write_supported`). Returns the updated arrays
+    in order; each output aliases its field's buffer, so only the halo
+    tiles move through VMEM — K fields cost one kernel launch, not K."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    K = len(arrays)
+    out_shapes = []
+    for a, (sl, sr) in zip(arrays, slab_pairs):
+        try:
+            vma = jax.typeof(a).vma | jax.typeof(sl).vma | jax.typeof(sr).vma
+            out_shapes.append(jax.ShapeDtypeStruct(a.shape, a.dtype, vma=vma))
+        except (AttributeError, TypeError):
+            out_shapes.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+
+    if dim == 0:
+        slab_ops, slab_specs, arr_specs, out_specs = [], [], [], []
+        for a, (sl, sr) in zip(arrays, slab_pairs):
+            _, ny, nz = a.shape
+            slab_ops.append(jnp.concatenate([sl, sr], axis=0))  # (2hw, ny, nz)
+            slab_specs.append(pl.BlockSpec((1, ny, nz), lambda i: (i, 0, 0)))
+            arr_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+            out_specs.append(pl.BlockSpec(
+                (1, ny, nz),
+                partial(_dim0_multi_out_map, s=a.shape[0], hw=hw)))
+
+        def kernel(*refs):
+            for k in range(K):
+                refs[2 * K + k][...] = refs[k][...]
+
+        return pl.pallas_call(
+            kernel,
+            grid=(2 * hw,),
+            in_specs=slab_specs + arr_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            input_output_aliases={K + k: k for k in range(K)},
+            interpret=interpret,
+        )(*slab_ops, *arrays)
+
+    # dim 1: per-field RMW of the aligned edge strips, shared (nx, 2) grid.
+    strip = _ceil_to(hw, _SUBLANE)
+    pad = strip - hw
+    nx = arrays[0].shape[0]
+    slab_ops, slab_specs, arr_specs, out_specs = [], [], [], []
+    for a, (sl, sr) in zip(arrays, slab_pairs):
+        nz = a.shape[2]
+        slab_ops.append(jnp.stack([
+            jnp.pad(sl, ((0, 0), (0, pad), (0, 0))),
+            jnp.pad(sr, ((0, 0), (pad, 0), (0, 0))),
+        ]))                                          # (2, nx, strip, nz)
+        blk_a = (1, strip, nz)
+        slab_specs.append(pl.BlockSpec((1,) + blk_a,
+                                       lambda i, j: (j, i, 0, 0)))
+        a_map = partial(_dim1_multi_a_map, last=a.shape[1] // strip - 1)
+        arr_specs.append(pl.BlockSpec(blk_a, a_map))
+        out_specs.append(pl.BlockSpec(blk_a, a_map))
+
+    kernel = partial(_multi_rmw_kernel, K=K, hw=hw, strip=strip)
+    return pl.pallas_call(
+        kernel,
+        grid=(nx, 2),
+        in_specs=slab_specs + arr_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        input_output_aliases={K + k: k for k in range(K)},
+        interpret=interpret,
+    )(*slab_ops, *arrays)
+
+
+def _dim1_multi_a_map(i, j, *, last):
+    return (i, j * last, 0)                        # j=0: first, j=1: last strip
+
+
+def _multi_rmw_kernel(*refs, K, hw, strip):
+    """Per (x-plane, side) grid step: merge every field's slab into its
+    aligned edge strip (the K-field form of `_rmw_kernel`)."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    left = j == 0  # scalar-predicate select over bool vectors won't legalize
+    for k in range(K):
+        cur = refs[K + k][0]
+        sl = refs[k][0, 0]
+        pos = lax.broadcasted_iota(jnp.int32, cur.shape, 0)
+        mask = (left & (pos < hw)) | (~left & (pos >= strip - hw))
+        refs[2 * K + k][0] = jnp.where(mask, sl, cur)
 
 
 # ---------------------------------------------------------------------------

@@ -22,7 +22,84 @@ surrounding stencil kernel.
 
 from __future__ import annotations
 
-__all__ = ["stochastic_round_bf16", "shard_unique_fold"]
+__all__ = ["stochastic_round_bf16", "shard_unique_fold",
+           "resolve_wire_dtype", "wire_dtype_for"]
+
+
+# ---------------------------------------------------------------------------
+# Halo wire-precision mode (EQuARX-style reduced-precision collectives,
+# arXiv:2506.17615): f32/f64 state optionally crosses the ICI link as a
+# narrower float — convert → pack → ppermute → unpack → convert back
+# (`ops.halo`). OFF by default: the exchange stays bit-identical unless the
+# user opts in via `IGG_HALO_WIRE_DTYPE` or the `wire_dtype=` kwarg of
+# `update_halo`/`local_update_halo`.
+# ---------------------------------------------------------------------------
+
+_WIRE_OFF = (None, "", "0", "off", "none")
+
+
+def resolve_wire_dtype(wire_dtype=None):
+    """Resolve the requested halo wire dtype to a canonical numpy dtype, or
+    ``None`` for full-precision wire (the default).
+
+    ``wire_dtype=None`` consults ``IGG_HALO_WIRE_DTYPE``; an explicit
+    argument (incl. ``"off"``) wins over the environment. Accepted wire
+    formats: ``bfloat16``, ``float16``, ``float32`` (the narrowing target
+    per state dtype is decided by :func:`wire_dtype_for`)."""
+    import os
+
+    from ..utils.exceptions import InvalidArgumentError
+
+    if wire_dtype is None:
+        wire_dtype = os.environ.get("IGG_HALO_WIRE_DTYPE")
+    if isinstance(wire_dtype, str):
+        wire_dtype = wire_dtype.strip().lower()
+    if wire_dtype in _WIRE_OFF:
+        return None
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    named = {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+             "float16": np.float16, "f16": np.float16, "fp16": np.float16,
+             "float32": np.float32, "f32": np.float32}
+    if isinstance(wire_dtype, str):
+        if wire_dtype not in named:
+            raise InvalidArgumentError(
+                f"Unsupported halo wire dtype {wire_dtype!r}; supported: "
+                "bfloat16, float16, float32 (or 'off').")
+        return np.dtype(named[wire_dtype])
+    dt = np.dtype(wire_dtype)
+    if dt not in {np.dtype(v) for v in named.values()}:
+        raise InvalidArgumentError(
+            f"Unsupported halo wire dtype {dt}; supported: bfloat16, "
+            "float16, float32 (or 'off').")
+    return dt
+
+
+def wire_dtype_for(state_dtype, wire):
+    """The on-wire dtype for halo payloads of ``state_dtype`` under resolved
+    wire mode ``wire`` (from :func:`resolve_wire_dtype`), or ``None`` when
+    the payload ships at full precision.
+
+    Only genuine narrowings of real floating state apply: ints, bools,
+    complex, and states already at or below the wire width are never
+    converted (a widening round trip would waste bandwidth; int/complex
+    conversion would corrupt values)."""
+    if wire is None:
+        return None
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    sd = np.dtype(state_dtype)
+    if not jnp.issubdtype(sd, jnp.floating):
+        return None
+    wd = np.dtype(wire)
+    if wd.itemsize >= sd.itemsize:
+        return None
+    return wd
 
 
 def stochastic_round_bf16(x, key):

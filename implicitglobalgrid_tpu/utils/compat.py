@@ -1,0 +1,51 @@
+"""JAX version compatibility shims.
+
+The framework targets the modern `jax.shard_map` entry point (promoted out
+of `jax.experimental` in JAX 0.4.35+ nightlies / 0.5-era releases, with the
+variance checker renamed ``check_rep`` -> ``check_vma``). Installed JAX
+builds differ on both counts, so every `shard_map` in the package and the
+test/bench harnesses routes through :func:`shard_map` here:
+
+- resolve `jax.shard_map` vs `jax.experimental.shard_map.shard_map`;
+- translate the ``check_vma=`` kwarg to legacy ``check_rep=`` when the
+  resolved function predates the rename (same meaning: ``False`` disables
+  the per-output mesh-axis variance/replication checker, required whenever
+  Pallas kernels run under the map — see `ops.halo.halo_may_use_pallas`).
+
+Resolution happens lazily on first use (importing `jax` at module import
+would defeat the package's lazy-jax layout) and is cached.
+"""
+
+from __future__ import annotations
+
+__all__ = ["shard_map"]
+
+_resolved = None  # (fn, vma_kwarg_name) once resolved
+
+
+def _resolve():
+    global _resolved
+    if _resolved is None:
+        import inspect
+
+        import jax
+
+        fn = getattr(jax, "shard_map", None)
+        if fn is None:
+            from jax.experimental.shard_map import shard_map as fn
+        params = inspect.signature(fn).parameters
+        kw = "check_vma" if "check_vma" in params else "check_rep"
+        _resolved = (fn, kw)
+    return _resolved
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """Version-portable `shard_map`.
+
+    ``check_vma=None`` keeps the resolved function's own default (checker
+    on); ``True``/``False`` is forwarded under whichever name the installed
+    JAX accepts (``check_vma``, or legacy ``check_rep``).
+    """
+    fn, kw = _resolve()
+    kwargs = {} if check_vma is None else {kw: bool(check_vma)}
+    return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)

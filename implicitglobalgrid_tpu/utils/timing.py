@@ -49,8 +49,10 @@ def _drain_fn(gg, sig):
             s = lax.psum(s, ax)
         return s
 
-    return jax.jit(jax.shard_map(drain, mesh=gg.mesh, in_specs=specs,
-                                 out_specs=P()))
+    from .compat import shard_map
+
+    return jax.jit(shard_map(drain, mesh=gg.mesh, in_specs=specs,
+                             out_specs=P()))
 
 
 def _sync_strong(tree):
@@ -143,7 +145,9 @@ def _device_barrier() -> None:
                 s = jax.lax.psum(s, ax)
             return s
 
-        fn = jax.jit(jax.shard_map(probe, mesh=mesh, in_specs=P(), out_specs=P()))
+        from .compat import shard_map
+
+        fn = jax.jit(shard_map(probe, mesh=mesh, in_specs=P(), out_specs=P()))
         _probe_cache[key] = fn
     # concrete fetch, not block_until_ready — the latter can return early
     # on some PJRT transports (see `sync`)

@@ -210,7 +210,14 @@ def _run_children(tmp_path, nproc, dcn, ndev, timeout=240, child=_CHILD):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    unsupported = "Multiprocess computations aren't implemented on the CPU backend"
     for pid, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 and unsupported in out:
+            # environment capability, not a framework bug: jax 0.4.x's CPU
+            # backend has no cross-process computations (they landed with
+            # the jax>=0.5 CPU collectives) — nothing the framework can do
+            pytest.skip("this jaxlib's CPU backend cannot run cross-process "
+                        "computations (needs the jax>=0.5 CPU collectives)")
         assert p.returncode == 0, f"process {pid} failed:\n{out[-3000:]}"
         assert f"MP_OK {pid}" in out
 

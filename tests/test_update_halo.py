@@ -265,12 +265,14 @@ def test_local_update_halo_inside_shard_map():
     import jax
     from jax.sharding import PartitionSpec as P
 
+    from implicitglobalgrid_tpu.utils.compat import shard_map
+
     igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, periody=1, quiet=True)
     gg = igg.global_grid()
     enc = encode(igg.zeros_g())
     Pz = zero_halos(enc, (5, 5, 5), (1, 1, 1), (0, 1, 2))
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda a: igg.local_update_halo(a),
         mesh=gg.mesh, in_specs=P("gx", "gy", "gz"), out_specs=P("gx", "gy", "gz"),
     ))
@@ -450,6 +452,198 @@ def test_pallas_combined_unpack_staggered_matches_dus():
     finally:
         halo_mod._FORCE_PALLAS_WRITE_INTERPRET = False
     assert np.array_equal(r_dus, r_pal)
+
+
+# ---------------------------------------------------------------------------
+# Coalesced multi-field exchange (one packed ppermute pair per mesh axis and
+# dtype group, `ops/halo.py` module docstring) — must be BIT-IDENTICAL to the
+# per-field path on every configuration: packing is ravel/concat, the wire
+# carries the same values.
+# ---------------------------------------------------------------------------
+
+def _exchange_both_ways(fields, **kw):
+    """(coalesced, per_field) update_halo results as numpy arrays."""
+    a = igg.update_halo(*fields, coalesce=True, **kw)
+    b = igg.update_halo(*fields, coalesce=False, **kw)
+    if len(fields) == 1:
+        a, b = (a,), (b,)
+    return ([np.asarray(x) for x in a], [np.asarray(x) for x in b])
+
+
+@pytest.mark.parametrize("n,dims,periods,kw,label", [
+    (6, (2, 2, 2), (1, 1, 1), {}, "all-periodic"),
+    (6, (2, 2, 2), (0, 0, 0), {}, "non-periodic PROC_NULL edges"),
+    (6, (1, 2, 2), (1, 0, 1), {}, "x self-neighbor + y PROC_NULL + z multi"),
+    (6, (4, 2, 1), (1, 0, 1), {"disp": 2}, "disp=2"),
+    (9, (2, 2, 2), (1, 0, 1),
+     {"overlaps": (4, 4, 4), "halowidths": (2, 2, 2)}, "halowidth 2"),
+])
+def test_coalesced_matches_per_field(n, dims, periods, kw, label):
+    igg.init_global_grid(n, n, n, dimx=dims[0], dimy=dims[1], dimz=dims[2],
+                         periodx=periods[0], periody=periods[1],
+                         periodz=periods[2], quiet=True, **kw)
+    rng = np.random.default_rng(7)
+    stacked = tuple(int(d) * n for d in igg.global_grid().dims)
+
+    def mk(dtype):
+        return igg.device_put_g(
+            rng.standard_normal(stacked).astype(dtype))
+
+    fields = [mk(np.float64) for _ in range(3)]
+    co, pf = _exchange_both_ways(fields)
+    for c, p in zip(co, pf):
+        assert np.array_equal(c, p), label
+
+
+def test_coalesced_mixed_dtypes_and_fallback():
+    """3 f32 + 2 f64 + 1 int32: two packed groups plus a per-field
+    fallback for the lone-dtype field — all bit-identical to the
+    fully per-field path."""
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=2,
+                         periodx=1, quiet=True)
+    rng = np.random.default_rng(8)
+    fields = [igg.device_put_g(rng.standard_normal((12, 12, 12)).astype(dt))
+              for dt in [np.float32] * 3 + [np.float64] * 2 + [np.int32]]
+    co, pf = _exchange_both_ways(fields)
+    for c, p in zip(co, pf):
+        assert np.array_equal(c, p)
+
+
+def test_coalesced_per_field_halowidths_and_stagger():
+    """Fields disagreeing on halowidths and shape (staggered +1) still
+    pack — the flat packer carries per-field slab sizes; results equal
+    the per-field path exactly."""
+    igg.init_global_grid(9, 9, 9, dimx=2, dimy=2, dimz=2,
+                         overlaps=(4, 4, 4), periodx=1, periody=1, quiet=True)
+    rng = np.random.default_rng(9)
+    A = igg.device_put_g(rng.standard_normal((18, 18, 18)))
+    B = igg.device_put_g(rng.standard_normal((18, 18, 18)))   # hw (1,1,1)
+    Vx = igg.device_put_g(rng.standard_normal((20, 18, 18)))  # staggered +1
+    fields = [A, igg.Field(B, (1, 1, 1)), Vx]
+    co, pf = _exchange_both_ways(fields)
+    for c, p in zip(co, pf):
+        assert np.array_equal(c, p)
+    # and against the oracle (coalesced path is reference-exact, not just
+    # per-field-path-exact)
+    exp = oracle_update(np.asarray(A), (9, 9, 9), (2, 2, 2),
+                        igg.DEFAULT_DIMS_ORDER)
+    assert np.array_equal(co[0], exp)
+
+
+def test_coalesced_2d_and_participation_mix():
+    """2-D grid with a field that participates only along one dim (no halo
+    along the other): group membership is per-dim; fallback engages where
+    packing is inapplicable."""
+    igg.init_global_grid(6, 6, 1, dimx=4, dimy=2,
+                         periodx=1, periody=1, quiet=True)
+    rng = np.random.default_rng(10)
+    A = igg.device_put_g(rng.standard_normal((24, 12)))
+    B = igg.device_put_g(rng.standard_normal((24, 12)))
+    co, pf = _exchange_both_ways([A, B])
+    for c, p in zip(co, pf):
+        assert np.array_equal(c, p)
+
+
+def test_coalesced_pallas_multi_unpack_matches_dus():
+    """The multi-field Pallas unpack kernel (interpret mode) delivers the
+    same bits as the XLA dynamic-update-slice unpack on the coalesced
+    path."""
+    import implicitglobalgrid_tpu.ops.halo as halo_mod
+
+    igg.init_global_grid(16, 16, 128, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    rng = np.random.default_rng(11)
+    fs = [igg.device_put_g(
+        rng.standard_normal((32, 32, 256)).astype(np.float32))
+        for _ in range(3)]
+    fs.append(igg.device_put_g(                      # staggered +1 along x
+        rng.standard_normal((34, 32, 256)).astype(np.float32)))
+    try:
+        halo_mod._FORCE_PALLAS_WRITE_INTERPRET = False
+        dus = [np.asarray(igg.gather(x)) for x in igg.update_halo(*fs)]
+        halo_mod._FORCE_PALLAS_WRITE_INTERPRET = True
+        pal = [np.asarray(igg.gather(x)) for x in igg.update_halo(*fs)]
+    finally:
+        halo_mod._FORCE_PALLAS_WRITE_INTERPRET = False
+    for d, p in zip(dus, pal):
+        assert np.array_equal(d, p)
+
+
+# ---------------------------------------------------------------------------
+# Wire-precision mode (`IGG_HALO_WIRE_DTYPE` / wire_dtype=) — opt-in only.
+# ---------------------------------------------------------------------------
+
+def test_wire_precision_defaults_off_and_is_bit_identical_when_off():
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=2, periodx=1,
+                         quiet=True)
+    rng = np.random.default_rng(12)
+    A = igg.device_put_g(rng.standard_normal((12, 12, 12)).astype(np.float32))
+    B = igg.device_put_g(rng.standard_normal((12, 12, 12)).astype(np.float32))
+    r_default = igg.update_halo(A, B)
+    r_off = igg.update_halo(A, B, wire_dtype="off")
+    for x, y in zip(r_default, r_off):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_wire_precision_bf16_rounds_interior_keeps_boundary_exact():
+    """bf16 wire: interior-facing halos carry bf16-rounded values (within
+    bf16 eps of the exact exchange); PROC_NULL boundary halos never cross
+    the wire and stay exact; the coalesced and per-field wire paths round
+    identically (bit-identical to each other)."""
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=2, quiet=True)
+    rng = np.random.default_rng(13)
+    A = igg.device_put_g(rng.standard_normal((12, 12, 12)).astype(np.float32))
+    B = igg.device_put_g(rng.standard_normal((12, 12, 12)).astype(np.float32))
+    exact = [np.asarray(x) for x in igg.update_halo(A, B)]
+    co = [np.asarray(x) for x in
+          igg.update_halo(A, B, wire_dtype="bfloat16", coalesce=True)]
+    pf = [np.asarray(x) for x in
+          igg.update_halo(A, B, wire_dtype="bfloat16", coalesce=False)]
+    for c, p in zip(co, pf):
+        assert np.array_equal(c, p)  # packing never changes rounding
+    for c, e in zip(co, exact):
+        assert np.allclose(c, e, rtol=2 ** -7, atol=2 ** -7)  # bf16 eps
+        assert not np.array_equal(c, e)  # the rounding actually happened
+        # physical-boundary halo cells (PROC_NULL, non-periodic grid) never
+        # cross the wire: exact. Restrict to cells of the x=0 plane that are
+        # not ALSO y/z halo cells of their shard (those receive later y/z
+        # exchange slabs, which do go through the wire).
+        assert np.array_equal(c[0, 1:5, 1:5], e[0, 1:5, 1:5])
+        assert np.array_equal(c[-1, 7:11, 7:11], e[-1, 7:11, 7:11])
+
+
+def test_wire_precision_ignores_non_float_fields():
+    """int32 payloads never convert (conversion would corrupt them)."""
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=2, periodx=1,
+                         quiet=True)
+    rng = np.random.default_rng(14)
+    A = igg.device_put_g(rng.integers(-1000, 1000, (12, 12, 12)).astype(np.int32))
+    B = igg.device_put_g(rng.integers(-1000, 1000, (12, 12, 12)).astype(np.int32))
+    r_wire = igg.update_halo(A, B, wire_dtype="bfloat16")
+    r_exact = igg.update_halo(A, B)
+    for x, y in zip(r_wire, r_exact):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_wire_precision_env_var():
+    import os
+
+    import implicitglobalgrid_tpu.ops.halo as halo_mod
+
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=2, periodx=1,
+                         quiet=True)
+    rng = np.random.default_rng(15)
+    A = igg.device_put_g(rng.standard_normal((12, 12, 12)).astype(np.float32))
+    B = igg.device_put_g(rng.standard_normal((12, 12, 12)).astype(np.float32))
+    explicit = [np.asarray(x)
+                for x in igg.update_halo(A, B, wire_dtype="bfloat16")]
+    os.environ["IGG_HALO_WIRE_DTYPE"] = "bfloat16"
+    try:
+        via_env = [np.asarray(x) for x in igg.update_halo(A, B)]
+    finally:
+        del os.environ["IGG_HALO_WIRE_DTYPE"]
+    for x, y in zip(explicit, via_env):
+        assert np.array_equal(x, y)
 
 
 def test_pallas_halo_multi_field_matches_dus():
