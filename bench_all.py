@@ -134,6 +134,15 @@ def main() -> None:
     for row in bench_halo.run_coalescing_ab(dims3, cpu):
         results.append(bench_util.emit(row))
 
+    # --- resilience guard overhead (guarded vs plain chunk) ----------------
+    # the supervised driver's per-chunk health probe + fetch as a fraction
+    # of step time; target < 2% (ISSUE 2). Config owned by
+    # `bench_resilience.run_guard_overhead` (shared with the standalone).
+    import bench_resilience
+
+    for row in bench_resilience.run_guard_overhead(dims3, cpu):
+        results.append(bench_util.emit(row))
+
     # --- pseudo-transient Stokes 3-D (BASELINE config 5) -------------------
     nxs, nts = (24, 20) if cpu else (128, 300)
     igg.init_global_grid(nxs, nxs, nxs, dimx=dims3[0], dimy=dims3[1],

@@ -49,10 +49,19 @@ from .tools import (
     nx_g, ny_g, nz_g, x_g, y_g, z_g, x_g_vec, y_g_vec, z_g_vec, coords_g,
 )
 from .utils.timing import tic, toc, barrier, sync
-from .utils.profiling import trace, annotate, overlap_stats, op_breakdown
+from .utils.profiling import (
+    trace, annotate, overlap_stats, op_breakdown,
+    health_counters, record_health_event, reset_health_counters,
+)
 from .utils.checkpoint import (
     save_checkpoint, restore_checkpoint, load_checkpoint,
     save_checkpoint_sharded, restore_checkpoint_sharded,
+    restore_checkpoint_elastic, saved_topology, elastic_local_size,
+)
+from .runtime import (
+    run_resilient, GuardConfig, HealthReport, RecoveryPolicy,
+    NaNPoke, CheckpointCorruption, ProcessLoss,
+    poke_nan, corrupt_checkpoint, elastic_restart,
 )
 from .utils import exceptions
 
@@ -70,6 +79,12 @@ __all__ = [
     "x_g_vec", "y_g_vec", "z_g_vec", "coords_g",
     "save_checkpoint", "restore_checkpoint", "load_checkpoint",
     "save_checkpoint_sharded", "restore_checkpoint_sharded",
+    "restore_checkpoint_elastic", "saved_topology", "elastic_local_size",
+    # resilient runtime (supervised long runs)
+    "run_resilient", "GuardConfig", "HealthReport", "RecoveryPolicy",
+    "NaNPoke", "CheckpointCorruption", "ProcessLoss",
+    "poke_nan", "corrupt_checkpoint", "elastic_restart",
+    "health_counters", "record_health_event", "reset_health_counters",
     "d_xa", "d_ya", "d_za", "d_xi", "d_yi", "d_zi", "inn",
     "stochastic_round_bf16",
     # state/introspection

@@ -113,7 +113,8 @@ def validate_deep_halo(gg, ndim: int, k: int, depth_per_step: int = 1
 
 
 def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
-                      check_vma: bool | None = None, unroll: int | None = None):
+                      check_vma: bool | None = None, unroll: int | None = None,
+                      post_chunk=None):
     """Compile ``state -> state`` advancing ``nt_chunk`` steps.
 
     ``step_local(state) -> state`` operates on a tuple of LOCAL blocks;
@@ -123,6 +124,20 @@ def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
     ``check_vma=None`` resolves via `default_check_vma` (off only when the
     halo layer emits Pallas kernels; pass False yourself if the step uses
     Pallas directly).
+
+    ``post_chunk(state) -> aux`` is the in-chunk guard hook (the resilient
+    runtime's health probe, `runtime/health.py`): it runs INSIDE the same
+    shard_map program once after the time loop, and its (replicated,
+    ``P()``-spec'ed) result is appended to the runner's outputs — the
+    compiled chunk becomes ``state -> (*state, aux)``. Because it lives in
+    the chunk body, whatever it computes rides the one compiled program:
+    no extra dispatch, and any reduction it performs (e.g. ONE psum of a
+    tiny stats vector) is the only collective added per chunk boundary.
+    The hook's module-qualified name joins the cache key (so the guarded
+    and unguarded runners, or two different module-level hooks, never
+    collide), but — exactly like ``step_local`` itself — the closure's
+    CONTENT does not: two distinct hooks sharing a qualname (closures from
+    one factory) need distinct ``key``s.
 
     ``unroll`` (default 4 on TPU, 1 elsewhere) unrolls the time loop body:
     XLA's while-loop buffer assignment pins each carry to ONE buffer, so a
@@ -152,26 +167,41 @@ def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
         from ..ops.pallas_stencil import kernel_flags
         from ..ops.precision import resolve_wire_dtype
 
+        hook_id = None if post_chunk is None else (
+            getattr(post_chunk, "__module__", None),
+            getattr(post_chunk, "__qualname__", repr(post_chunk)))
         full_key = (gg.epoch, key, tuple(state_ndims), int(nt_chunk),
                     bool(check_vma), int(unroll), kernel_flags(),
                     resolve_halo_coalesce(None),
-                    str(resolve_wire_dtype(None)))
+                    str(resolve_wire_dtype(None)), hook_id)
         fn = _runner_cache.get(full_key)
         if fn is not None:
             return fn
         if _runner_cache and next(iter(_runner_cache))[0] != gg.epoch:
             _runner_cache.clear()
     specs = tuple(field_partition_spec(nd) for nd in state_ndims)
+    out_specs = specs
 
-    def chunk(*state):
-        out = lax.fori_loop(0, nt_chunk, lambda i, s: tuple(step_local(s)),
-                            tuple(state), unroll=unroll)
-        return out
+    if post_chunk is None:
+        def chunk(*state):
+            return lax.fori_loop(0, nt_chunk,
+                                 lambda i, s: tuple(step_local(s)),
+                                 tuple(state), unroll=unroll)
+    else:
+        from jax.sharding import PartitionSpec as P
+
+        out_specs = specs + (P(),)
+
+        def chunk(*state):
+            out = lax.fori_loop(0, nt_chunk,
+                                lambda i, s: tuple(step_local(s)),
+                                tuple(state), unroll=unroll)
+            return out + (post_chunk(out),)
 
     from ..utils.compat import shard_map
 
     fn = jax.jit(shard_map(
-        chunk, mesh=gg.mesh, in_specs=specs, out_specs=specs,
+        chunk, mesh=gg.mesh, in_specs=specs, out_specs=out_specs,
         check_vma=check_vma,
     ))
     if key is not None:

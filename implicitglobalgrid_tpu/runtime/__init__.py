@@ -1,0 +1,20 @@
+"""Resilient simulation runtime: supervised long runs over the chunked
+runners — on-device health guards, double-buffered elastic
+checkpoint-restart, deterministic fault injection (no reference analog;
+the reference's runtime story ends at `tic`/`toc`, SURVEY §5.4)."""
+
+from .driver import run_resilient
+from .faults import (
+    CheckpointCorruption, NaNPoke, ProcessLoss, corrupt_checkpoint,
+    poke_nan,
+)
+from .health import GuardConfig, HealthReport, make_guarded_runner
+from .recovery import RecoveryPolicy, elastic_restart
+
+__all__ = [
+    "run_resilient",
+    "GuardConfig", "HealthReport", "make_guarded_runner",
+    "RecoveryPolicy", "elastic_restart",
+    "NaNPoke", "CheckpointCorruption", "ProcessLoss",
+    "poke_nan", "corrupt_checkpoint",
+]

@@ -1,0 +1,104 @@
+"""Deterministic fault injection — every recovery path exercised, not
+believed.
+
+The resilient driver's recovery machinery (rollback, checkpoint fallback,
+elastic restart) would otherwise only run in production incidents; these
+faults let tier-1 tests drive each path deterministically
+(`tests/test_resilience.py`), the same philosophy as the reference wiring
+its exchange through 1-process self-neighbor tests rather than trusting MPI.
+
+Three fault species, all consumed exactly once by `run_resilient`:
+
+- `NaNPoke` — silent-data-corruption model: one cell of one field is set
+  to NaN at an exact step (the driver splits its chunk schedule so the
+  poke lands at the requested step boundary). The health guard must trip
+  within the following chunk and the driver roll back.
+- `CheckpointCorruption` — storage-failure model: right after the N-th
+  checkpoint save completes, its directory is truncated/bit-flipped/
+  deleted on disk. The next restore must detect it (content checksums,
+  `utils/checkpoint.py`) and fall back to the other slot.
+- `ProcessLoss` — preemption/lost-chip model: at an exact step the live
+  state is ABANDONED and the grid re-initialized with ``new_dims``; the
+  driver elastically restores the last good checkpoint onto the new
+  decomposition and recomputes the lost steps.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = ["NaNPoke", "CheckpointCorruption", "ProcessLoss",
+           "poke_nan", "corrupt_checkpoint"]
+
+
+@dataclass(frozen=True)
+class NaNPoke:
+    """Set ``state[name][index] = NaN`` when the run reaches ``step``
+    (``index`` in the STACKED layout — it addresses a cell of a specific
+    shard, the 'chosen shard at a chosen step' of the injection matrix)."""
+    step: int
+    name: str
+    index: tuple = (0, 0, 0)
+
+
+@dataclass(frozen=True)
+class CheckpointCorruption:
+    """Corrupt the checkpoint written by save number ``save_index``
+    (0-based, counting the driver's initial step-0 save) immediately after
+    it completes. ``kind``: ``"truncate"`` | ``"bitflip"`` | ``"delete"``;
+    ``target``: ``"shard"`` (process ``process``'s file) | ``"meta"``."""
+    save_index: int
+    kind: str = "truncate"
+    target: str = "shard"
+    process: int = 0
+
+
+@dataclass(frozen=True)
+class ProcessLoss:
+    """Abandon the live state at ``step`` and restart elastically on a
+    grid decomposed as ``new_dims`` (same implicit global grid)."""
+    step: int
+    new_dims: tuple
+
+
+def poke_nan(A, index=(0, 0, 0)):
+    """Return ``A`` with the cell at stacked ``index`` set to NaN (the
+    injection primitive behind `NaNPoke`; usable standalone in tests)."""
+    return A.at[tuple(int(i) for i in index)].set(float("nan"))
+
+
+def corrupt_checkpoint(dirpath, *, kind: str = "truncate",
+                       target: str = "shard", process: int = 0) -> None:
+    """Damage a sharded checkpoint directory ON DISK (the injection
+    primitive behind `CheckpointCorruption`): truncate the target file to
+    half its size, flip one byte in its middle, or delete it. The content
+    checksums added by `save_checkpoint_sharded` guarantee a later restore
+    raises instead of reassembling garbage."""
+    from ..utils.exceptions import InvalidArgumentError
+
+    if kind not in ("truncate", "bitflip", "delete"):
+        raise InvalidArgumentError(
+            f"corrupt_checkpoint kind must be truncate|bitflip|delete, "
+            f"got {kind!r}.")
+    if target not in ("shard", "meta"):
+        raise InvalidArgumentError(
+            f"corrupt_checkpoint target must be shard|meta, got {target!r}.")
+    fname = "meta.npz" if target == "meta" else f"shards_p{process}.npz"
+    path = os.path.join(dirpath, fname)
+    if not os.path.exists(path):
+        raise InvalidArgumentError(
+            f"corrupt_checkpoint: no such checkpoint file {path}.")
+    if kind == "delete":
+        os.remove(path)
+        return
+    size = os.path.getsize(path)
+    if kind == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+        return
+    with open(path, "r+b") as f:  # bitflip: one byte, mid-file
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")

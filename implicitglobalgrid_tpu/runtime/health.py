@@ -1,0 +1,128 @@
+"""On-device health guards for long runs — fused into the chunk body.
+
+The reference leaves long-run survival entirely to the user (SURVEY §5.4:
+`tic`/`toc` is its whole observability surface). Here every chunk of a
+supervised run (`runtime/driver.py`) carries a tiny guard program INSIDE the
+compiled chunk (`make_state_runner(post_chunk=...)`, `models/common.py`):
+per field, a non-finite count and a squared-norm accumulator are computed on
+the chunk's FINAL state and reduced with ONE small `psum` over all mesh axes
+— one extra collective per chunk boundary, regardless of field count (the
+same coalescing argument as the PR-1 halo exchange: compose reductions into
+one collective rather than one per field — cf. HiCCL, arXiv:2408.05962).
+The HLO-level guarantee is audited in `tests/test_hlo_audit.py`.
+
+Checking the final state (not every sub-step) is sound for the blow-up modes
+the guard targets: a NaN/Inf born anywhere in a stencil state propagates and
+persists, so it is still visible at the chunk boundary — the driver detects
+it within one chunk of its birth and rolls back to the last good checkpoint.
+
+The replicated stats vector costs one tiny D2H fetch per chunk; fetching it
+doubles as the chunk-boundary drain (it data-depends on every shard of the
+final state, the `utils.timing.sync` guarantee).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+__all__ = ["GuardConfig", "HealthReport", "make_guarded_runner",
+           "health_stats_local", "report_from_stats"]
+
+
+@dataclass(frozen=True)
+class GuardConfig:
+    """What trips the guard.
+
+    ``check_nonfinite``: any NaN/Inf cell in any field trips (default ON).
+    ``rms_limit``: field-norm divergence threshold — a scalar applied to
+    every field, or a dict ``name -> limit`` (fields absent from the dict
+    are unchecked). The tested quantity is the RMS over the STACKED layout
+    (overlap cells counted per copy — cheap and decomposition-stable
+    enough for a divergence guard), accumulated in float32."""
+    check_nonfinite: bool = True
+    rms_limit: float | dict | None = None
+
+    def limit_for(self, name: str):
+        if isinstance(self.rms_limit, dict):
+            return self.rms_limit.get(name)
+        return self.rms_limit
+
+
+@dataclass(frozen=True)
+class HealthReport:
+    """Per-chunk guard verdict (one per compiled chunk of a supervised run).
+
+    ``nonfinite`` counts NaN/Inf cells per field (float32 accumulation:
+    exact up to 2^24, saturating precision beyond — the trip condition is
+    ``> 0`` either way); ``rms`` is the stacked-layout RMS per field;
+    ``reasons`` names every tripped guard (``"nonfinite:T"``,
+    ``"rms:T"``); ``ok`` is ``not reasons``."""
+    chunk: int
+    step_begin: int
+    step_end: int
+    nonfinite: dict
+    rms: dict
+    reasons: tuple = ()
+
+    @property
+    def ok(self) -> bool:
+        return not self.reasons
+
+
+def health_stats_local(state) -> "jax.Array":  # noqa: F821
+    """The in-chunk guard probe (LOCAL blocks, inside shard_map): a
+    ``(2*nfields,)`` float32 vector ``[nonfinite_0, norm2_0, nonfinite_1,
+    …]`` summed over every shard with ONE `psum` over all mesh axes —
+    replicated on return, so the runner can emit it under a ``P()`` spec."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..parallel.topology import AXIS_NAMES
+
+    parts = []
+    for x in state:
+        xf = x.astype(jnp.float32)
+        parts.append(jnp.sum((~jnp.isfinite(x)).astype(jnp.float32)))
+        parts.append(jnp.sum(xf * xf))
+    vec = jnp.stack(parts)
+    return lax.psum(vec, AXIS_NAMES)
+
+
+def make_guarded_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
+                        check_vma: bool | None = None,
+                        unroll: int | None = None):
+    """`models.common.make_state_runner` with the health probe fused into
+    the chunk: the compiled program is ``state -> (*state, stats_vec)``.
+    ``key`` namespaces the runner cache separately from any unguarded
+    runner of the same step function."""
+    from ..models.common import make_state_runner
+
+    return make_state_runner(
+        step_local, state_ndims, nt_chunk=nt_chunk,
+        key=None if key is None else (key, "igg_health_guard"),
+        check_vma=check_vma, unroll=unroll, post_chunk=health_stats_local)
+
+
+def report_from_stats(vec, names, sizes, guard: GuardConfig, *,
+                      chunk: int, step_begin: int, step_end: int
+                      ) -> HealthReport:
+    """Build the host-side `HealthReport` from the fetched stats vector.
+    ``sizes`` are the stacked cell counts per field (RMS denominator)."""
+    nonfinite, rms, reasons = {}, {}, []
+    for i, name in enumerate(names):
+        bad = float(vec[2 * i])
+        norm2 = float(vec[2 * i + 1])
+        nonfinite[name] = int(bad)
+        r = math.sqrt(norm2 / sizes[i]) if sizes[i] else 0.0
+        if math.isnan(norm2) or math.isinf(norm2):
+            r = float("inf")  # f32 norm2 overflow: divergence either way
+        rms[name] = r
+        if guard.check_nonfinite and bad > 0:
+            reasons.append(f"nonfinite:{name}")
+        limit = guard.limit_for(name)
+        if limit is not None and not r <= float(limit):
+            reasons.append(f"rms:{name}")
+    return HealthReport(chunk=chunk, step_begin=step_begin,
+                        step_end=step_end, nonfinite=nonfinite, rms=rms,
+                        reasons=tuple(reasons))

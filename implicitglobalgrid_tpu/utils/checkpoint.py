@@ -10,17 +10,19 @@ plus the recorded grid topology make save/restore a pair of calls
 >>> import implicitglobalgrid_tpu as igg
 >>> _ = igg.init_global_grid(4, 4, 4, dimx=2, dimy=2, dimz=2, quiet=True)
 >>> T = igg.ones_g()
->>> path = os.path.join(tempfile.mkdtemp(), "ckpt.npz")
+>>> tmp = tempfile.TemporaryDirectory()   # doctests must not leak dirs
+>>> path = os.path.join(tmp.name, "ckpt.npz")
 >>> igg.save_checkpoint(path, {"T": T}, step=42)
 >>> state, step = igg.restore_checkpoint(path)   # arrays re-sharded
 >>> step, tuple(state["T"].shape)
 (42, (8, 8, 8))
->>> sdir = os.path.join(tempfile.mkdtemp(), "ckpt_dir")   # pod-scale path
+>>> sdir = os.path.join(tmp.name, "ckpt_dir")   # pod-scale path
 >>> igg.save_checkpoint_sharded(sdir, {"T": T}, step=43)
 >>> state, step = igg.restore_checkpoint_sharded(sdir)
 >>> step
 43
 >>> igg.finalize_global_grid()
+>>> tmp.cleanup()
 
 Two formats:
 
@@ -41,6 +43,24 @@ Two formats:
   missing). Requires a filesystem reachable by all processes (the normal
   pod setup).
 
+Durability and integrity (the resilient runtime's substrate,
+`runtime/driver.py`):
+
+- The sharded save is ATOMIC at the directory level: every file is staged
+  into `<dirpath>.tmp-<token>` (fsync'ed), and only after every process
+  finished and `meta.npz` committed the set does the staging directory
+  take the final name — a crash at any point leaves either the previous
+  complete checkpoint or a stale `.tmp-`/`.old-` directory, never a
+  half-written `dirpath`.
+- Every file carries a `<file>.sha256` content-checksum sidecar, verified
+  on restore before any block from that file is used — a truncated or
+  bit-flipped file raises `IncoherentArgumentError` instead of restoring
+  garbage silently.
+- `restore_checkpoint_elastic` restores onto a grid whose `dims` DIFFER
+  from the saved decomposition (the elastic-restart path): the same
+  implicit global grid is re-blocked from the saved block coordinates,
+  each process reading only the saved blocks its shards overlap.
+
 `restore_checkpoint*` validates the topology against the live grid and
 re-shards onto the current mesh, so a run can resume on different hardware
 with the same decomposition. In multi-host runs save/restore are collective
@@ -57,7 +77,9 @@ from ..parallel.topology import check_initialized, global_grid
 from ..utils.exceptions import IncoherentArgumentError, InvalidArgumentError
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "load_checkpoint",
-           "save_checkpoint_sharded", "restore_checkpoint_sharded"]
+           "save_checkpoint_sharded", "restore_checkpoint_sharded",
+           "restore_checkpoint_elastic", "saved_topology",
+           "elastic_local_size"]
 
 _META_PREFIX = "__igg_meta__"
 _ARR_PREFIX = "__igg_arr__"
@@ -73,12 +95,80 @@ def _grid_meta(gg) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# File integrity: fsync'ed writes + sha256 content sidecars
+# ---------------------------------------------------------------------------
+
+def _file_sha256(path) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_npz_synced(path, payload: dict) -> None:
+    """`np.savez` to ``path`` with fsync, plus a ``<path>.sha256``
+    content-checksum sidecar (also fsync'ed) verified on restore."""
+    with open(path, "wb") as f:
+        np.savez(f, **payload)
+        f.flush()
+        os.fsync(f.fileno())
+    side = path + ".sha256"
+    with open(side + ".tmp", "w") as f:
+        f.write(_file_sha256(path) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(side + ".tmp", side)
+
+
+def _verify_checksum(path, *, required: bool) -> None:
+    """Compare ``path`` against its ``.sha256`` sidecar. ``required=False``
+    tolerates a MISSING sidecar (checkpoints from before the checksum
+    format); a PRESENT sidecar is always enforced."""
+    side = path + ".sha256"
+    if not os.path.exists(side):
+        if required:
+            raise IncoherentArgumentError(
+                f"Checkpoint file {path} has no .sha256 sidecar but the "
+                "save recorded checksums — the directory was tampered with "
+                "or partially copied; do not resume from it.")
+        return
+    with open(side) as f:
+        expect = f.read().strip()
+    got = _file_sha256(path)
+    if got != expect:
+        raise IncoherentArgumentError(
+            f"Checkpoint file {path} is corrupt: content checksum "
+            f"{got[:12]}… does not match the recorded {expect[:12]}… — the "
+            "file was truncated or bit-flipped after the save; restore "
+            "from another checkpoint.")
+
+
+def _fsync_dir(path) -> None:
+    """Durability for a commit rename (POSIX: the rename is only durable
+    once the parent directory is fsync'ed); best-effort on platforms
+    without directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def save_checkpoint(path, state: dict, *, step: int | None = None,
                     root: int = 0) -> None:
     """Write ``state`` (a dict name -> stacked global array) and the grid
     topology to ``path`` (.npz). Collective in multi-host runs; only ``root``
-    writes the file. Writes atomically (tmp file + rename) so an interrupted
-    save never corrupts an existing checkpoint."""
+    writes the file. Writes atomically (fsync'ed tmp file + rename) so an
+    interrupted save never corrupts an existing checkpoint."""
     import jax
 
     from ..ops.gather import gather
@@ -103,6 +193,8 @@ def save_checkpoint(path, state: dict, *, step: int | None = None,
         tmp = f"{path}.tmp"
         with open(tmp, "wb") as f:
             np.savez(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     # All processes synchronize on the completed write so an immediately
     # following restore_checkpoint never reads a stale/missing file on
@@ -142,8 +234,9 @@ def _validate_topology(meta: dict, gg, strict: bool,
         live = np.asarray(getattr(gg, name))
         if saved is None or not np.array_equal(np.asarray(saved), live):
             hint = ("Re-init the grid to match (sharded restore cannot "
-                    "reshard; use the single-file restore_checkpoint for "
-                    "that)." if hard else
+                    "reshard; use the single-file restore_checkpoint, or "
+                    "restore_checkpoint_elastic for a dims change)."
+                    if hard else
                     "Re-init the grid to match or pass strict=False.")
             raise IncoherentArgumentError(
                 f"Checkpoint topology mismatch for `{name}`: saved "
@@ -164,7 +257,14 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
                             step: int | None = None) -> None:
     """Write ``state`` to directory ``dirpath`` with each process saving
     only its ADDRESSABLE shards (pod-scale path: no host gathers the
-    global state). Collective; atomic per file (tmp + rename)."""
+    global state). Collective; ATOMIC at the directory level: all files
+    are staged into ``<dirpath>.tmp-<token>`` (fsync + per-file sha256
+    sidecars) and the staging dir takes the final name only after
+    ``meta.npz`` commits the complete set. Replacing an existing
+    checkpoint moves it aside first, so ``dirpath`` is briefly absent
+    during the swap — the resilient driver's double-buffered slots +
+    pointer file (`runtime/driver.py`) cover that window; standalone users
+    should alternate two directories for the same guarantee."""
     import jax
 
     from ..ops.alloc import device_put_g
@@ -180,13 +280,12 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
                 f"Invalid state key {k!r}: keys must be strings without "
                 "'__' and not starting with '__igg_'.")
     gg = global_grid()
-    os.makedirs(dirpath, exist_ok=True)
     pidx = jax.process_index()
 
     # One token per SAVE, shared by all processes: restore validates every
     # shard file against it, so a crash between one process's write and
-    # another's can never silently mix blocks from two different saves
-    # (per-file tmp+rename is atomic; the multi-file SET is not).
+    # another's can never silently mix blocks from two different saves.
+    # The token also names the staging directory all processes write into.
     import secrets
 
     if jax.process_count() > 1:
@@ -197,6 +296,9 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
         token = bytes(np.asarray(token_arr)).hex()
     else:
         token = secrets.token_hex(16)
+
+    stage = f"{dirpath}.tmp-{token}"
+    os.makedirs(stage, exist_ok=True)
 
     payload = {f"{_META_PREFIX}save_token": np.str_(token)}
     names, shapes, dtypes = [], {}, {}
@@ -212,10 +314,7 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
                 continue  # replicated shards: one copy is enough
             payload[_shard_key(k, _starts_of(s.index))] = np.asarray(s.data)
 
-    shard_path = os.path.join(dirpath, f"shards_p{pidx}.npz")
-    with open(shard_path + ".tmp", "wb") as f:
-        np.savez(f, **payload)
-    os.replace(shard_path + ".tmp", shard_path)
+    _write_npz_synced(os.path.join(stage, f"shards_p{pidx}.npz"), payload)
 
     # Barrier BEFORE process 0 writes meta.npz: meta carries the new save
     # token, so it must be the COMMIT record of a fully-written shard set.
@@ -231,57 +330,65 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
         meta[f"{_META_PREFIX}names"] = np.asarray(names)
         meta[f"{_META_PREFIX}save_token"] = np.str_(token)
         meta[f"{_META_PREFIX}nprocs_files"] = np.int64(jax.process_count())
+        meta[f"{_META_PREFIX}checksums"] = np.str_("sha256")
         meta.update(shapes)
         meta.update(dtypes)
         if step is not None:
             meta[f"{_META_PREFIX}step"] = np.int64(step)
-        meta_path = os.path.join(dirpath, "meta.npz")
-        with open(meta_path + ".tmp", "wb") as f:
-            np.savez(f, **meta)
-        os.replace(meta_path + ".tmp", meta_path)
-        # Remove stale shard files from an earlier save with MORE
-        # processes (no current process writes these indices): leftovers
-        # would otherwise be globbed by a later restore and could shadow
-        # the new state with old-step blocks.
-        import glob as _glob
-        import re as _re
+        _write_npz_synced(os.path.join(stage, "meta.npz"), meta)
+        # Commit: the complete staging dir takes the final name (one
+        # rename). A pre-existing checkpoint is moved aside first and
+        # removed after the swap — stale shard files from an earlier save
+        # with MORE processes can no longer shadow the new state (the
+        # whole directory is replaced, not patched file-by-file).
+        old = None
+        if os.path.exists(dirpath):
+            old = f"{dirpath}.old-{token}"
+            os.rename(dirpath, old)
+        os.rename(stage, dirpath)
+        _fsync_dir(os.path.dirname(os.path.abspath(dirpath)) or ".")
+        if old is not None:
+            import shutil
 
-        for f in _glob.glob(os.path.join(dirpath, "shards_p*.npz")):
-            m = _re.search(r"shards_p(\d+)\.npz$", f)
-            if m and int(m.group(1)) >= jax.process_count():
-                os.remove(f)
+            shutil.rmtree(old, ignore_errors=True)
 
     # Final barrier: no process returns (and possibly starts the NEXT
-    # save, or reports the checkpoint usable) before meta.npz exists.
+    # save, or reports the checkpoint usable) before the commit rename.
     barrier()
 
 
-def restore_checkpoint_sharded(dirpath, *, strict: bool = True):
-    """Load a `save_checkpoint_sharded` directory and reassemble every
-    array on the live mesh from block coordinates — each process reads its
-    own shard file first and scans the others only for blocks it misses,
-    so no process ever holds the global state. Returns ``(state, step)``."""
-    import glob as _glob
-
-    import jax
-
-    from ..ops.alloc import sharding_of
-
-    check_initialized()
-    gg = global_grid()
+def _load_meta(dirpath) -> dict:
+    """Open + verify + prefix-strip ``meta.npz`` — the ONE meta-loading
+    path (shared by the restores and `saved_topology`). The file is
+    checksum-verified BEFORE parsing (a corrupt meta must raise the typed
+    error, not a raw zipfile one); ``required=False`` tolerates
+    pre-checksum-format saves, which have no sidecars at all."""
     meta_path = os.path.join(dirpath, "meta.npz")
     if not os.path.exists(meta_path):
         raise InvalidArgumentError(
             f"Sharded checkpoint meta not found: {meta_path}")
+    _verify_checksum(meta_path, required=False)
     with np.load(meta_path) as z:
-        meta = {k[len(_META_PREFIX):]: z[k] for k in z.files
+        return {k[len(_META_PREFIX):]: z[k] for k in z.files
                 if k.startswith(_META_PREFIX)}
-    # nxyz/dims are REQUIRED even with strict=False: blocks are keyed by
-    # the saved block coordinates, so a different decomposition cannot be
-    # reassembled here (the single-file path reshards; this one does not).
-    _validate_topology(meta, gg, strict, required=("nxyz", "dims"))
-    names = [str(n) for n in meta["names"]]
-    step = int(meta["step"]) if "step" in meta else None
+
+
+def _sharded_meta_and_files(dirpath):
+    """Shared open/validate path of the sharded restores: load ``meta.npz``,
+    enumerate exactly the shard files the save wrote (own file first),
+    token-check every one, and checksum-verify meta plus this process's
+    own file up front. Returns ``(meta, files, checksums_required,
+    verified)``. Full-content checksums of OTHER processes' files are
+    deferred to scan time (`_block_scanner`) — an up-front whole-set
+    verification would read O(total bytes) on EVERY process, which the
+    pod-scale path cannot afford; every byte actually used is still
+    verified before use."""
+    import glob as _glob
+
+    import jax
+
+    meta = _load_meta(dirpath)
+    checksums = "checksums" in meta
 
     pidx = jax.process_index()
     # The meta records how many shard files the save wrote; read EXACTLY
@@ -301,10 +408,100 @@ def restore_checkpoint_sharded(dirpath, *, strict: bool = True):
         files.remove(own)
         files.insert(0, own)
 
+    # Token-check EVERY shard file up front (cheap: npz loads members
+    # lazily, so this reads one tiny array per file), not just the files
+    # this process happens to scan for blocks.  A lazy per-scan check is
+    # non-SPMD-uniform: after an interrupted save, a process whose blocks
+    # all sit in its own (valid) shard file would restore successfully
+    # while others raise — hanging the multi-host run at the next
+    # collective instead of failing cleanly on every process.
+    expect_token = str(meta["save_token"]) if "save_token" in meta else None
+    token_key = f"{_META_PREFIX}save_token"
+    if expect_token is not None:
+        for path in files:
+            try:
+                with np.load(path) as z:
+                    ftok = str(z[token_key]) if token_key in z.files else None
+            except Exception as e:
+                # unreadable container: name the real cause if the content
+                # checksum disagrees (truncation), else surface the error
+                _verify_checksum(path, required=checksums)
+                raise IncoherentArgumentError(
+                    f"Unreadable shard file {path}: {e!r}") from e
+            if ftok != expect_token:
+                raise IncoherentArgumentError(
+                    f"Shard file {path} belongs to a different save than "
+                    "meta.npz (save-token mismatch) — the save was "
+                    "interrupted; do not resume from this checkpoint.")
+
+    verified = set()
+    if own in files:
+        _verify_checksum(own, required=checksums)
+        verified.add(own)
+    return meta, files, checksums, verified
+
+
+def _block_scanner(files, wanted: set, checksums_required: bool,
+                   verified: set, *, pop: bool = True):
+    """Lazy scan over the shard files for the keys in ``wanted``: each file
+    is opened at most once (checksum-verified on first open) and each
+    found block cached, so host memory stays at this process' shard
+    volume even after a process->shard remap (the pod-scale guarantee).
+    ``pop=True`` drops a block once consumed (the plain restore's one
+    consumer per block); ``pop=False`` keeps it cached — the elastic
+    restore reuses one saved block for several live blocks."""
+
+    blocks: dict = {}
+    unscanned = list(files)
+
+    def find_block(key: str):
+        while key not in blocks and unscanned:
+            path = unscanned.pop(0)
+            if path not in verified:
+                _verify_checksum(path, required=checksums_required)
+                verified.add(path)
+            with np.load(path) as z:
+                for k in z.files:
+                    if k in wanted:
+                        blocks[k] = z[k]
+        if key not in blocks:
+            raise IncoherentArgumentError(
+                f"Sharded checkpoint is missing block `{key}` — was the "
+                "save interrupted, or written with a different topology?")
+        return blocks.pop(key) if pop else blocks[key]
+
+    return find_block
+
+
+def restore_checkpoint_sharded(dirpath, *, strict: bool = True,
+                               _preloaded=None):
+    """Load a `save_checkpoint_sharded` directory and reassemble every
+    array on the live mesh from block coordinates — each process reads its
+    own shard file first and scans the others only for blocks it misses,
+    so no process ever holds the global state. Every file read is verified
+    against its content checksum first. Returns ``(state, step)``.
+
+    ``_preloaded`` carries an already-built `_sharded_meta_and_files`
+    result (the elastic restore's dims-match delegation — re-running the
+    open/token/checksum pass would double the restore I/O)."""
+    import jax
+
+    from ..ops.alloc import sharding_of
+
+    check_initialized()
+    gg = global_grid()
+    meta, files, checksums, verified = (
+        _preloaded if _preloaded is not None
+        else _sharded_meta_and_files(dirpath))
+    # nxyz/dims are REQUIRED even with strict=False: blocks are keyed by
+    # the saved block coordinates, so a different decomposition cannot be
+    # reassembled here (use restore_checkpoint_elastic for that).
+    _validate_topology(meta, gg, strict, required=("nxyz", "dims"))
+    names = [str(n) for n in meta["names"]]
+    step = int(meta["step"]) if "step" in meta else None
+
     # Every block THIS process needs, across all arrays — scanning loads
-    # only these keys and each is dropped once consumed, so host memory
-    # stays at this process' shard volume even after a process->shard
-    # remap (the pod-scale guarantee of this path).
+    # only these keys and each is dropped once consumed.
     plans = {}
     wanted: set = set()
     for name in names:
@@ -316,40 +513,7 @@ def restore_checkpoint_sharded(dirpath, *, strict: bool = True):
         wanted |= {_shard_key(name, _starts_of(idx))
                    for idx in needed.values()}
 
-    blocks: dict = {}       # key -> np.ndarray, only keys in `wanted`
-    unscanned = list(files)
-    expect_token = str(meta["save_token"]) if "save_token" in meta else None
-    token_key = f"{_META_PREFIX}save_token"
-
-    # Token-check EVERY shard file up front (cheap: npz loads members
-    # lazily, so this reads one tiny array per file), not just the files
-    # this process happens to scan for blocks.  A lazy per-scan check is
-    # non-SPMD-uniform: after an interrupted save, a process whose blocks
-    # all sit in its own (valid) shard file would restore successfully
-    # while others raise — hanging the multi-host run at the next
-    # collective instead of failing cleanly on every process.
-    if expect_token is not None:
-        for path in files:
-            with np.load(path) as z:
-                ftok = str(z[token_key]) if token_key in z.files else None
-            if ftok != expect_token:
-                raise IncoherentArgumentError(
-                    f"Shard file {path} belongs to a different save than "
-                    "meta.npz (save-token mismatch) — the save was "
-                    "interrupted; do not resume from this checkpoint.")
-
-    def find_block(key: str):
-        while key not in blocks and unscanned:
-            path = unscanned.pop(0)
-            with np.load(path) as z:
-                for k in z.files:
-                    if k in wanted:
-                        blocks[k] = z[k]
-        if key not in blocks:
-            raise IncoherentArgumentError(
-                f"Sharded checkpoint is missing block `{key}` — was the "
-                "save interrupted, or written with a different topology?")
-        return blocks.pop(key)
+    find_block = _block_scanner(files, wanted, checksums, verified)
 
     out = {}
     for name in names:
@@ -366,6 +530,215 @@ def restore_checkpoint_sharded(dirpath, *, strict: bool = True):
             arrays.extend(jax.device_put(block, dev) for dev in devs)
         out[name] = jax.make_array_from_single_device_arrays(
             shape, sharding, arrays)
+    return out, step
+
+
+# ---------------------------------------------------------------------------
+# Elastic restore: same implicit global grid, different decomposition
+# ---------------------------------------------------------------------------
+
+def saved_topology(dirpath) -> dict:
+    """Host-only read of a sharded checkpoint's saved grid topology:
+    ``{nxyz, dims, overlaps, periods, halowidths, step}`` — no initialized
+    grid required (the elastic-restart path reads it to size and re-init
+    the grid BEFORE restoring, `runtime/recovery.py`)."""
+    meta = _load_meta(dirpath)
+    out = {name: np.asarray(meta[name], dtype=np.int64)
+           for name in ("nxyz", "dims", "overlaps", "periods", "halowidths")}
+    out["step"] = int(meta["step"]) if "step" in meta else None
+    return out
+
+
+def elastic_local_size(topo: dict, new_dims) -> tuple:
+    """LOCAL block size ``(nx, ny, nz)`` that decomposes the SAME implicit
+    global grid as ``topo`` (a `saved_topology` record) over ``new_dims``:
+    inverts ``nxyz_g = dims*(nxyz-overlaps) + overlaps*(periods==0)`` per
+    dimension. Raises when the interior does not divide evenly over the
+    requested decomposition."""
+    nxyz = np.asarray(topo["nxyz"], dtype=np.int64)
+    dims = np.asarray(topo["dims"], dtype=np.int64)
+    ol = np.asarray(topo["overlaps"], dtype=np.int64)
+    per = np.asarray(topo["periods"], dtype=np.int64)
+    new_dims = np.asarray(new_dims, dtype=np.int64)
+    nxyz_g = dims * (nxyz - ol) + ol * (per == 0)
+    out = []
+    for d in range(3):
+        interior = int(nxyz_g[d]) - (int(ol[d]) if not per[d] else 0)
+        nd = int(new_dims[d])
+        if nd < 1 or interior % nd:
+            raise IncoherentArgumentError(
+                f"Cannot redistribute dimension {d}: global interior "
+                f"{interior} does not divide evenly over new dims[{d}]="
+                f"{nd}.")
+        out.append(interior // nd + int(ol[d]))
+    return tuple(out)
+
+
+class _AxisRedistribution:
+    """Per-dimension owner/coverage maps of the elastic re-blocking.
+
+    Physical index space: the `gather_interior` convention — a periodic
+    dim has ``NG = dims*(n-ol)`` cells and every block OWNS ``n-ol`` of
+    them (its ghost cells wrap); a non-periodic dim has
+    ``NG = dims*(n-ol)+ol`` with the LAST block owning its full extent.
+    ``c_of[p]``/``i_of[p]`` give the saved block and block-local index
+    owning physical cell ``p``; `new_phys(c)` gives the physical index of
+    every local cell of live block ``c`` — so the reassembly is
+    ``new_block[i] = saved_block[c_of[g[i]]][i_of[g[i]]]`` with
+    ``g = new_phys(c)``. Because overlap cells are fetched from their
+    interior OWNER, a checkpoint with exchange-fresh halos (what the
+    framework runners produce) restores bit-identical to laying the
+    gathered global field out over the new decomposition."""
+
+    def __init__(self, n_old: int, n_new: int, dd_old: int, dd_new: int,
+                 ol_f: int, per: bool):
+        s_o, s_n = n_old - ol_f, n_new - ol_f
+        if per:
+            ng_o, ng_n = dd_old * s_o, dd_new * s_n
+        else:
+            ng_o, ng_n = dd_old * s_o + ol_f, dd_new * s_n + ol_f
+        if ng_o != ng_n:
+            raise IncoherentArgumentError(
+                f"Elastic restore: saved axis covers {ng_o} global cells, "
+                f"the live one {ng_n} — the decompositions describe "
+                "different global grids (staggering changed?).")
+        self.ng = ng_o
+        p = np.arange(self.ng)
+        if per:
+            c = p // s_o
+            i = p - c * s_o + 1
+        else:
+            c = np.minimum(p // s_o, dd_old - 1)
+            i = p - c * s_o
+        self.c_of, self.i_of = c, i
+        self._s_n, self._n_new, self._per = s_n, n_new, per
+
+    def new_phys(self, c: int) -> np.ndarray:
+        i = np.arange(self._n_new)
+        if self._per:
+            return (c * self._s_n + i - 1) % self.ng
+        return c * self._s_n + i
+
+
+def restore_checkpoint_elastic(dirpath):
+    """Restore a `save_checkpoint_sharded` directory onto a grid whose
+    ``dims`` DIFFER from the saved decomposition — the elastic-restart
+    path. The same implicit global grid is re-blocked: every live block is
+    assembled from the saved blocks overlapping its physical cells (block
+    coordinates, per-axis owner maps — the array-redistribution problem of
+    arXiv:2112.01075 restricted to Cartesian re-blocking), and each
+    process reads ONLY the saved blocks its own addressable shards need,
+    keeping the pod-scale guarantee.
+
+    Requires equal ``overlaps``/``periods``/``halowidths`` and the same
+    implicit global size (`elastic_local_size` computes the local block
+    size to re-init with); a live grid equal to the saved one delegates to
+    the plain block-keyed restore. Returns ``(state, step)``."""
+    import itertools
+
+    import jax
+
+    from ..ops.alloc import sharding_of
+
+    check_initialized()
+    gg = global_grid()
+    meta, files, checksums, verified = _sharded_meta_and_files(dirpath)
+    names = [str(n) for n in meta["names"]]
+    step = int(meta["step"]) if "step" in meta else None
+
+    dims_o = np.asarray(meta["dims"], dtype=np.int64)
+    nxyz_o = np.asarray(meta["nxyz"], dtype=np.int64)
+    if np.array_equal(dims_o, np.asarray(gg.dims)) and \
+            np.array_equal(nxyz_o, np.asarray(gg.nxyz)):
+        return restore_checkpoint_sharded(
+            dirpath, _preloaded=(meta, files, checksums, verified))
+    for field in ("overlaps", "periods", "halowidths"):
+        if not np.array_equal(np.asarray(meta[field]),
+                              np.asarray(getattr(gg, field))):
+            raise IncoherentArgumentError(
+                f"Elastic restore requires equal `{field}` (saved "
+                f"{list(np.asarray(meta[field]))}, live "
+                f"{list(np.asarray(getattr(gg, field)))}): only the "
+                "decomposition may change.")
+    ol = np.asarray(gg.overlaps, dtype=np.int64)
+    per = np.asarray(gg.periods, dtype=np.int64)
+    saved_g = dims_o * (nxyz_o - ol) + ol * (per == 0)
+    if not np.array_equal(saved_g, np.asarray(gg.nxyz_g)):
+        raise IncoherentArgumentError(
+            f"Elastic restore: saved implicit global grid {list(saved_g)} "
+            f"differs from the live one {list(np.asarray(gg.nxyz_g))}; "
+            "re-init with elastic_local_size(saved_topology(dir), dims).")
+
+    # Per-field plans: for each addressable live block, the saved blocks
+    # covering its physical cells and the index maps placing them.
+    plans = {}
+    wanted: set = set()
+    for name in names:
+        shape_o = tuple(int(s) for s in meta[f"shape__{name}"])
+        dtype = np.dtype(str(meta[f"dtype__{name}"]))
+        nd = len(shape_o)
+        loc_o, loc_n, axes = [], [], []
+        for d in range(nd):
+            dd_o = int(dims_o[d])
+            if shape_o[d] % dd_o:
+                raise IncoherentArgumentError(
+                    f"Saved stacked size {shape_o[d]} of `{name}` along "
+                    f"dimension {d} is not divisible by the saved "
+                    f"dims[{d}]={dd_o}.")
+            lo = shape_o[d] // dd_o
+            stag = lo - int(nxyz_o[d])      # staggered fields carry their
+            ln = int(gg.nxyz[d]) + stag     # extra cells to the new blocks
+            axes.append(_AxisRedistribution(
+                lo, ln, dd_o, int(gg.dims[d]),
+                int(ol[d]) + stag, bool(per[d])))
+            loc_o.append(lo)
+            loc_n.append(ln)
+        shape_n = tuple(int(gg.dims[d]) * loc_n[d] for d in range(nd))
+        sharding = sharding_of(nd)
+        needed = sharding.addressable_devices_indices_map(shape_n)
+        by_start: dict = {}
+        for dev, idx in needed.items():
+            by_start.setdefault(_starts_of(idx), []).append(dev)
+        blockplans = []
+        for starts, devs in by_start.items():
+            per_axis = []
+            for d in range(nd):
+                g = axes[d].new_phys(starts[d] // loc_n[d])
+                per_axis.append((axes[d].c_of[g], axes[d].i_of[g]))
+            pieces = []
+            for co in itertools.product(
+                    *[np.unique(pa[0]) for pa in per_axis]):
+                sel_new, sel_old = [], []
+                for d in range(nd):
+                    c_of, i_of = per_axis[d]
+                    jj = np.nonzero(c_of == co[d])[0]
+                    sel_new.append(jj)
+                    sel_old.append(i_of[jj])
+                key = _shard_key(
+                    name, tuple(int(co[d]) * loc_o[d] for d in range(nd)))
+                pieces.append((key, sel_new, sel_old))
+                wanted.add(key)
+            blockplans.append((devs, pieces))
+        plans[name] = (shape_n, dtype, sharding, tuple(loc_n), blockplans)
+
+    # pop=False: one saved block can source several live blocks (and the
+    # same live block via replicated mesh axes); cached blocks stay
+    # bounded by this process' shard volume plus its overlap neighbors.
+    find_block = _block_scanner(files, wanted, checksums, verified,
+                                pop=False)
+
+    out = {}
+    for name in names:
+        shape_n, dtype, sharding, loc_n, blockplans = plans[name]
+        arrays = []
+        for devs, pieces in blockplans:
+            block = np.empty(loc_n, dtype=dtype)
+            for key, sel_new, sel_old in pieces:
+                src = np.asarray(find_block(key), dtype=dtype)
+                block[np.ix_(*sel_new)] = src[np.ix_(*sel_old)]
+            arrays.extend(jax.device_put(block, dev) for dev in devs)
+        out[name] = jax.make_array_from_single_device_arrays(
+            shape_n, sharding, arrays)
     return out, step
 
 

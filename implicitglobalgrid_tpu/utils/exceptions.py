@@ -18,6 +18,7 @@ __all__ = [
     "KeywordArgumentError",
     "NotLoadedError",
     "NotSupportedError",
+    "ResilienceError",
 ]
 
 
@@ -58,3 +59,10 @@ class NotLoadedError(GlobalGridError):
 
 class NotSupportedError(GlobalGridError):
     """Feature unsupported for the given input (reference: `shared.jl:176` B>1 CellArrays)."""
+
+
+class ResilienceError(GlobalGridError):
+    """The resilient runtime could not recover a run: a health guard tripped
+    with no usable checkpoint, every checkpoint slot failed to restore, or
+    the bounded retry budget of the recovery policy was exhausted (no
+    reference analog — the reference has no runtime supervision at all)."""

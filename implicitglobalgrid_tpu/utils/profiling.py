@@ -31,7 +31,38 @@ from __future__ import annotations
 import contextlib
 import re
 
-__all__ = ["trace", "annotate", "overlap_stats", "op_breakdown"]
+__all__ = ["trace", "annotate", "overlap_stats", "op_breakdown",
+           "health_counters", "record_health_event", "reset_health_counters"]
+
+
+# ---------------------------------------------------------------------------
+# Resilient-runtime health counters (`runtime/driver.py` records, operators
+# export) — the monitoring story the reference lacks entirely (SURVEY §5.4:
+# tic/toc is its whole observability surface). Process-local, monotone
+# within a run; the driver records one event per chunk/guard/recovery
+# action, so a metrics exporter can scrape `health_counters()` alongside
+# `overlap_stats` without touching driver internals.
+# ---------------------------------------------------------------------------
+
+_health_counters: dict = {}
+
+
+def record_health_event(kind: str, n: int = 1) -> None:
+    """Bump the ``kind`` counter by ``n`` (used by `runtime.run_resilient`:
+    kinds include ``chunks``, ``guard_trips``, ``rollbacks``,
+    ``checkpoints_saved``, ``restores``, ``restore_fallbacks``,
+    ``elastic_restarts``, ``escalations``)."""
+    _health_counters[kind] = _health_counters.get(kind, 0) + int(n)
+
+
+def health_counters() -> dict:
+    """Snapshot of the resilient-runtime counters (a copy — safe to mutate)."""
+    return dict(_health_counters)
+
+
+def reset_health_counters() -> None:
+    """Zero all counters (test isolation; scrape-and-reset exporters)."""
+    _health_counters.clear()
 
 
 @contextlib.contextmanager

@@ -137,6 +137,152 @@ def test_sharded_interrupted_save_detected(tmp_path):
         igg.restore_checkpoint_sharded(d)
 
 
+def test_sharded_checksum_detects_bitflip(tmp_path):
+    """Per-file content checksums: a bit-flipped shard file must raise the
+    typed corruption error on restore, never reassemble garbage."""
+    import os
+
+    _init()
+    d = str(tmp_path / "ck")
+    igg.save_checkpoint_sharded(d, {"A": igg.ones_g()}, step=1)
+    path = os.path.join(d, "shards_p0.npz")
+    assert os.path.exists(path + ".sha256")  # sidecar written by the save
+    igg.corrupt_checkpoint(d, kind="bitflip", target="shard")
+    with pytest.raises(IncoherentArgumentError, match="corrupt"):
+        igg.restore_checkpoint_sharded(d)
+
+
+def test_sharded_checksum_detects_truncation_and_meta_flip(tmp_path):
+    _init()
+    d = str(tmp_path / "ck")
+    igg.save_checkpoint_sharded(d, {"A": igg.ones_g()}, step=1)
+    igg.corrupt_checkpoint(d, kind="truncate", target="shard")
+    with pytest.raises(IncoherentArgumentError, match="corrupt"):
+        igg.restore_checkpoint_sharded(d)
+    igg.finalize_global_grid()
+    _init()
+    igg.save_checkpoint_sharded(d, {"A": igg.ones_g()}, step=2)  # fresh dir
+    st, sp = igg.restore_checkpoint_sharded(d)  # re-save replaced the dir
+    assert sp == 2
+    igg.corrupt_checkpoint(d, kind="bitflip", target="meta")
+    with pytest.raises(IncoherentArgumentError, match="corrupt"):
+        igg.restore_checkpoint_sharded(d)
+
+
+def test_sharded_save_leaves_no_staging_dirs(tmp_path):
+    """The atomic commit: after a save (including an overwrite) the parent
+    holds exactly the checkpoint dir — no .tmp-/.old- staging leftovers."""
+    import os
+
+    _init()
+    d = str(tmp_path / "ck")
+    igg.save_checkpoint_sharded(d, {"A": igg.ones_g()}, step=1)
+    igg.save_checkpoint_sharded(d, {"A": igg.zeros_g()}, step=2)
+    assert sorted(os.listdir(tmp_path)) == ["ck"]
+    st, sp = igg.restore_checkpoint_sharded(d)
+    assert sp == 2 and float(np.asarray(st["A"]).max()) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Elastic restore: same implicit global grid, different decomposition
+# ---------------------------------------------------------------------------
+
+def _stacked_from_phys(P):
+    """Independent construction of the stacked layout of physical field
+    ``P`` on the LIVE grid (the `gather_interior` inverse): the elastic
+    restore must be bit-identical to this."""
+    gg = igg.global_grid()
+    dims = [int(x) for x in gg.dims]
+    n = [int(x) for x in gg.nxyz]
+    ol = [int(x) for x in gg.overlaps]
+    per = [int(x) for x in gg.periods]
+    out = np.empty([dims[k] * n[k] for k in range(3)], P.dtype)
+    for c in np.ndindex(*dims):
+        idx = []
+        for k in range(3):
+            i = np.arange(n[k])
+            if per[k]:
+                idx.append((c[k] * (n[k] - ol[k]) + i - 1) % P.shape[k])
+            else:
+                idx.append(c[k] * (n[k] - ol[k]) + i)
+        dst = tuple(slice(c[k] * n[k], (c[k] + 1) * n[k]) for k in range(3))
+        out[dst] = P[np.ix_(*idx)]
+    return out
+
+
+@pytest.mark.parametrize("dims_a,dims_b", [
+    ((2, 1, 1), (1, 2, 1)),
+    ((2, 2, 1), (4, 1, 1)),
+    ((2, 2, 2), (1, 1, 1)),
+])
+def test_elastic_restore_bit_identical_across_dims(tmp_path, dims_a, dims_b):
+    """Save under one decomposition, restore under another: the restored
+    STACKED state must be bit-identical to laying the same physical global
+    field out over the new decomposition (block-coordinate reassembly
+    end-to-end, mixed periodic/non-periodic axes, f64 + f32 fields)."""
+    NG = (10, 10, 6)  # x,y non-periodic (interior 8 divides 1/2/4), z periodic
+
+    def local_size(dims):
+        return ((NG[0] - 2) // dims[0] + 2, (NG[1] - 2) // dims[1] + 2,
+                NG[2] // dims[2] + 2)
+
+    na = local_size(dims_a)
+    igg.init_global_grid(*na, dimx=dims_a[0], dimy=dims_a[1],
+                         dimz=dims_a[2], periodz=1, quiet=True)
+    assert tuple(int(x) for x in igg.global_grid().nxyz_g) == NG
+    rng = np.random.default_rng(7)
+    P = rng.standard_normal(NG)
+    Q = rng.standard_normal(NG).astype(np.float32)
+    A = igg.device_put_g(_stacked_from_phys(P))
+    B = igg.device_put_g(_stacked_from_phys(Q))
+    d = str(tmp_path / "ck")
+    igg.save_checkpoint_sharded(d, {"A": A, "B": B}, step=9)
+    igg.finalize_global_grid()
+
+    topo = igg.saved_topology(d)
+    assert topo["step"] == 9
+    nb = igg.elastic_local_size(topo, dims_b)
+    assert nb == local_size(dims_b)
+    igg.init_global_grid(*nb, dimx=dims_b[0], dimy=dims_b[1],
+                         dimz=dims_b[2], periodz=1, quiet=True)
+    state, step = igg.restore_checkpoint_elastic(d)
+    assert step == 9
+    assert state["B"].dtype == np.float32
+    assert np.array_equal(np.asarray(state["A"]), _stacked_from_phys(P))
+    assert np.array_equal(np.asarray(state["B"]),
+                          _stacked_from_phys(Q).astype(np.float32))
+    # and the physical field survives the round trip exactly
+    assert np.array_equal(igg.gather_interior(state["A"]), P)
+
+
+def test_elastic_restore_same_dims_delegates(tmp_path):
+    _init()
+    d = str(tmp_path / "ck")
+    T = igg.device_put_g(np.arange(1000, dtype=np.float64).reshape(10, 10, 10))
+    igg.save_checkpoint_sharded(d, {"T": T}, step=3)
+    state, step = igg.restore_checkpoint_elastic(d)  # same grid: fast path
+    assert step == 3
+    assert np.array_equal(np.asarray(state["T"]), np.asarray(T))
+
+
+def test_elastic_restore_rejects_incompatible(tmp_path):
+    _init()
+    d = str(tmp_path / "ck")
+    igg.save_checkpoint_sharded(d, {"A": igg.ones_g()})
+    topo = igg.saved_topology(d)
+    # indivisible decomposition is rejected up front (periodic x interior
+    # is 2*(5-2)=6 cells: 4 shards cannot split it evenly)
+    with pytest.raises(IncoherentArgumentError, match="divide"):
+        igg.elastic_local_size(topo, (4, 1, 1))
+    # different overlaps on the live grid: only dims may change
+    igg.finalize_global_grid()
+    igg.init_global_grid(7, 7, 7, dimx=2, dimy=2, dimz=2, periodx=1,
+                         overlaps=(4, 4, 4), halowidths=(2, 2, 2),
+                         quiet=True)
+    with pytest.raises(IncoherentArgumentError, match="overlaps"):
+        igg.restore_checkpoint_elastic(d)
+
+
 def test_load_without_grid(tmp_path):
     _init()
     p = str(tmp_path / "ckpt.npz")

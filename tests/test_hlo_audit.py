@@ -427,6 +427,50 @@ def test_overlap_interior_independent_of_permutes():
         "optimization_barrier does not guard the interior result")
 
 
+def _count_all_reduces(hlo):
+    starts = len(re.findall(r"all-reduce-start", hlo))
+    if starts:
+        return starts
+    return len(re.findall(r"= \S* ?all-reduce\(", hlo))
+
+
+def test_guarded_runner_adds_exactly_one_small_allreduce():
+    """THE resilient-runtime wire claim: the health guard fused into a
+    chunk (`runtime/health.make_guarded_runner`) costs exactly ONE extra
+    collective — a tiny all-reduce of the (2*nfields,) stats vector —
+    regardless of field count or chunk length, and does not perturb the
+    exchange's permute count (same audit style as the coalescing tests)."""
+    from implicitglobalgrid_tpu.models import (
+        diffusion_step_local, init_diffusion3d,
+    )
+    from implicitglobalgrid_tpu.models.common import make_state_runner
+    from implicitglobalgrid_tpu.runtime.health import make_guarded_runner
+
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    T, Cp, p = init_diffusion3d(dtype=np.float32)
+
+    def step(s):
+        return (diffusion_step_local(s[0], s[1], p, "xla"), s[1])
+
+    for nt_chunk in (1, 4):
+        plain = make_state_runner(step, (3, 3), nt_chunk=nt_chunk,
+                                  key="hlo_plain")
+        guarded = make_guarded_runner(step, (3, 3), nt_chunk=nt_chunk,
+                                      key="hlo_guard")
+        hlo_p = plain.lower(T, Cp).compile().as_text()
+        hlo_g = guarded.lower(T, Cp).compile().as_text()
+        assert _count_all_reduces(hlo_p) == 0
+        assert _count_all_reduces(hlo_g) == 1
+        assert (_count_collective_permutes(hlo_g)
+                == _count_collective_permutes(hlo_p))
+        # the one collective is TINY: its payload is the (2*nfields,)=4
+        # stats vector, never a field-sized buffer
+        lines = [ln for ln in hlo_g.splitlines()
+                 if re.search(r"= \S* ?all-reduce(-start)?\(", ln)]
+        assert lines and all("f32[4]" in ln for ln in lines), lines
+
+
 def test_permute_count_with_halowidth_2():
     """halowidth>1 exchanges still cost one pair per axis (slab width is
     static, not a per-row loop)."""

@@ -1,0 +1,331 @@
+"""Resilient-runtime tests: the supervised driver loop with every recovery
+path driven by DETERMINISTIC fault injection (`runtime/faults.py`) — the
+acceptance bar is bit-identical final state vs an uninterrupted reference
+run, not 'the run survived'."""
+
+import os
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.utils.exceptions import (
+    InvalidArgumentError, ResilienceError,
+)
+
+
+def _init(dimx=2, dimy=2, dimz=1):
+    igg.init_global_grid(6, 6, 6, dimx=dimx, dimy=dimy, dimz=dimz,
+                         quiet=True)
+
+
+def _diffusion_step():
+    from implicitglobalgrid_tpu.models import (
+        diffusion_step_local, init_diffusion3d,
+    )
+
+    T, Cp, p = init_diffusion3d(dtype=np.float64)
+
+    def step(s):
+        return {"T": diffusion_step_local(s["T"], s["Cp"], p, "xla"),
+                "Cp": s["Cp"]}
+
+    return step, {"T": T, "Cp": Cp}
+
+
+_REF_CACHE: dict = {}
+
+
+def _reference_run(tmp_path, nt=20, nt_chunk=5):
+    """Uninterrupted reference: same driver, no faults; returns the
+    gathered interior (decomposition-independent comparison target).
+    Memoized — the fault-matrix tests all compare against the same run."""
+    key = (nt, nt_chunk)
+    if key in _REF_CACHE:
+        return _REF_CACHE[key]
+    _init()
+    step, state = _diffusion_step()
+    ref, reports = igg.run_resilient(
+        step, state, nt, nt_chunk=nt_chunk, key="resil_ref",
+        checkpoint_dir=str(tmp_path / "ck_ref"))
+    assert all(r.ok for r in reports)
+    P = igg.gather_interior(ref["T"])
+    igg.finalize_global_grid()
+    _REF_CACHE[key] = P
+    return P
+
+
+# ---------------------------------------------------------------------------
+# Public API completeness (satellite: the runtime API is exported top-level)
+# ---------------------------------------------------------------------------
+
+def test_public_api_exports():
+    for sym in ("run_resilient", "HealthReport", "GuardConfig",
+                "RecoveryPolicy", "NaNPoke", "CheckpointCorruption",
+                "ProcessLoss", "poke_nan", "corrupt_checkpoint",
+                "elastic_restart", "restore_checkpoint_elastic",
+                "saved_topology", "elastic_local_size", "health_counters",
+                "record_health_event", "reset_health_counters"):
+        assert hasattr(igg, sym), sym
+        assert sym in igg.__all__, sym
+
+
+def test_public_api_importable_in_subprocess():
+    """The satellite's literal check: a fresh interpreter can import the
+    package and resolve the runtime entry point (catches import cycles
+    that an already-imported test session would mask)."""
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import implicitglobalgrid_tpu as igg; igg.run_resilient"],
+        capture_output=True, text=True, timeout=240,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+# ---------------------------------------------------------------------------
+# Healthy-path semantics
+# ---------------------------------------------------------------------------
+
+def test_unsupervised_equivalence_and_reports(tmp_path):
+    """With no faults, run_resilient is exactly the chunked runner plus
+    reports: same trajectory as run_diffusion, one report per chunk."""
+    from implicitglobalgrid_tpu.models import init_diffusion3d, run_diffusion
+
+    _init()
+    step, state = _diffusion_step()
+    out, reports = igg.run_resilient(step, state, 15, nt_chunk=5,
+                                     key="resil_eq")
+    T0, Cp, p = init_diffusion3d(dtype=np.float64)
+    T_ref = run_diffusion(T0, Cp, p, 15, nt_chunk=5)
+    assert np.array_equal(np.asarray(out["T"]), np.asarray(T_ref))
+    assert len(reports) == 3 and all(r.ok for r in reports)
+    assert [r.step_begin for r in reports] == [0, 5, 10]
+    assert reports[-1].step_end == 15
+    assert all(r.nonfinite == {"T": 0, "Cp": 0} for r in reports)
+    assert all(r.rms["T"] > 0 for r in reports)
+
+
+def test_health_counters_record_and_reset(tmp_path):
+    igg.reset_health_counters()
+    _init()
+    step, state = _diffusion_step()
+    igg.run_resilient(step, state, 10, nt_chunk=5, key="resil_cnt",
+                      checkpoint_dir=str(tmp_path / "ck"))
+    c = igg.health_counters()
+    assert c["chunks"] == 2
+    assert c["checkpoints_saved"] == 3  # initial + one per chunk boundary
+    assert "guard_trips" not in c
+    igg.reset_health_counters()
+    assert igg.health_counters() == {}
+
+
+def test_guard_trip_without_checkpoint_is_fatal():
+    _init()
+    step, state = _diffusion_step()
+    state["T"] = igg.poke_nan(state["T"], (0, 0, 0))
+    with pytest.raises(ResilienceError, match="nonfinite:T"):
+        igg.run_resilient(step, state, 10, nt_chunk=5, key="resil_fatal")
+
+
+def test_rms_guard_trips():
+    """Field-norm divergence guard: a healthy state over a tiny rms_limit
+    must trip with the rms reason (per-field dict limits honored)."""
+    _init()
+    step, state = _diffusion_step()
+    with pytest.raises(ResilienceError, match="rms:T"):
+        igg.run_resilient(step, state, 10, nt_chunk=5, key="resil_rms",
+                          guard=igg.GuardConfig(rms_limit={"T": 1e-30}))
+
+
+def test_state_validation():
+    _init()
+    step, state = _diffusion_step()
+    with pytest.raises(InvalidArgumentError, match="non-empty dict"):
+        igg.run_resilient(step, (state["T"],), 10)
+    with pytest.raises(InvalidArgumentError, match="unknown field"):
+        igg.run_resilient(step, state, 10,
+                          faults=[igg.NaNPoke(step=1, name="nope")])
+    with pytest.raises(InvalidArgumentError, match="step range"):
+        igg.run_resilient(step, state, 10,
+                          faults=[igg.NaNPoke(step=99, name="T")])
+
+
+# ---------------------------------------------------------------------------
+# The fault-injection matrix (tier-1: every recovery path exercised)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_nan_injection_rollback_bit_identical(tmp_path):
+    """THE acceptance loop: inject NaN at step 12 → guard trips within one
+    chunk → rollback to last-good → run completes bit-identical to the
+    uninterrupted reference."""
+    P_ref = _reference_run(tmp_path)
+
+    _init()
+    igg.reset_health_counters()
+    step, state = _diffusion_step()
+    out, reports = igg.run_resilient(
+        step, state, 20, nt_chunk=5, key="resil_nan",
+        checkpoint_dir=str(tmp_path / "ck"),
+        faults=[igg.NaNPoke(step=12, name="T", index=(0, 0, 0))])
+
+    tripped = [r for r in reports if not r.ok]
+    assert len(tripped) == 1
+    # the chunk schedule split at the injection step and the guard tripped
+    # within that one chunk
+    assert tripped[0].step_begin == 12 and tripped[0].step_end <= 17
+    assert tripped[0].reasons == ("nonfinite:T",)
+    assert tripped[0].nonfinite["T"] > 0
+    c = igg.health_counters()
+    assert c["guard_trips"] == 1 and c["rollbacks"] == 1
+    assert np.array_equal(igg.gather_interior(out["T"]), P_ref)
+
+
+@pytest.mark.faults
+def test_process_loss_elastic_restart_identical(tmp_path):
+    """Simulated process loss at step 13: state abandoned, grid re-inited
+    with dims=(1,2,2), last-good checkpoint redistributed elastically,
+    lost steps recomputed — final interior identical to the reference run
+    on the ORIGINAL decomposition."""
+    P_ref = _reference_run(tmp_path)
+
+    _init()
+    igg.reset_health_counters()
+    step, state = _diffusion_step()
+    out, reports = igg.run_resilient(
+        step, state, 20, nt_chunk=5, key="resil_loss",
+        checkpoint_dir=str(tmp_path / "ck"),
+        faults=[igg.ProcessLoss(step=13, new_dims=(1, 2, 2))])
+
+    gg = igg.global_grid()
+    assert tuple(int(d) for d in gg.dims) == (1, 2, 2)  # run ended elastic
+    c = igg.health_counters()
+    assert c["elastic_restarts"] == 1
+    assert np.array_equal(igg.gather_interior(out["T"]), P_ref)
+
+
+@pytest.mark.faults
+def test_nan_after_elastic_restart_rolls_back_on_new_grid(tmp_path):
+    """Compound failure: process loss at 13 (elastic restart to (1,2,2)),
+    then SDC at 14 — the rollback after the restart must restore onto the
+    NEW decomposition (the driver re-anchors its slots right after the
+    elastic restore) and the run still end identical to the reference."""
+    P_ref = _reference_run(tmp_path)
+
+    _init()
+    igg.reset_health_counters()
+    step, state = _diffusion_step()
+    out, reports = igg.run_resilient(
+        step, state, 20, nt_chunk=5, key="resil_combo",
+        checkpoint_dir=str(tmp_path / "ck"),
+        faults=[igg.ProcessLoss(step=13, new_dims=(1, 2, 2)),
+                igg.NaNPoke(step=14, name="T")])
+    c = igg.health_counters()
+    assert c["elastic_restarts"] == 1
+    assert c["guard_trips"] == 1 and c["rollbacks"] == 1
+    assert np.array_equal(igg.gather_interior(out["T"]), P_ref)
+
+
+@pytest.mark.faults
+def test_checkpoint_corruption_falls_back_to_other_slot(tmp_path):
+    """Storage fault: the newest checkpoint is bit-flipped after its save;
+    the later rollback must DETECT it (content checksum) and fall back to
+    the other (older) slot, recompute, and still match the reference."""
+    P_ref = _reference_run(tmp_path)
+
+    _init()
+    igg.reset_health_counters()
+    step, state = _diffusion_step()
+    out, reports = igg.run_resilient(
+        step, state, 20, nt_chunk=5, key="resil_corrupt",
+        checkpoint_dir=str(tmp_path / "ck"),
+        faults=[igg.CheckpointCorruption(save_index=2, kind="bitflip"),
+                igg.NaNPoke(step=12, name="T")])
+    c = igg.health_counters()
+    assert c["rollbacks"] == 1 and c["restore_fallbacks"] == 1
+    assert np.array_equal(igg.gather_interior(out["T"]), P_ref)
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("kind,target", [
+    ("truncate", "shard"), ("delete", "shard"), ("bitflip", "meta"),
+])
+def test_corruption_matrix_both_slots_fatal(tmp_path, kind, target):
+    """Corrupting EVERY slot (here: the only save) must end in a clean
+    typed failure, never a garbage restore."""
+    _init()
+    step, state = _diffusion_step()
+    with pytest.raises(ResilienceError, match="No checkpoint slot"):
+        igg.run_resilient(
+            step, state, 10, nt_chunk=5, key=("resil_cm", kind, target),
+            checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=100,
+            faults=[igg.CheckpointCorruption(save_index=0, kind=kind,
+                                             target=target),
+                    igg.NaNPoke(step=7, name="T")])
+
+
+@pytest.mark.faults
+def test_persistent_failure_escalates_then_exhausts(tmp_path):
+    """A fault rollback cannot cure (the step function itself poisons the
+    state) must shrink the chunk (escalation hook called), then exhaust
+    the bounded retry budget with a typed error — no infinite loop."""
+    _init()
+    step, state = _diffusion_step()
+
+    def poisoned(s):
+        out = step(s)
+        return {"T": out["T"].at[0, 0, 0].set(float("nan")),
+                "Cp": out["Cp"]}
+
+    igg.reset_health_counters()
+    seen = []
+    with pytest.raises(ResilienceError, match="retry budget"):
+        igg.run_resilient(
+            poisoned, state, 20, nt_chunk=8, key="resil_poison",
+            checkpoint_dir=str(tmp_path / "ck"),
+            policy=igg.RecoveryPolicy(max_retries=3, shrink_chunk_after=2,
+                                      on_escalate=seen.append))
+    c = igg.health_counters()
+    assert c["guard_trips"] == 4  # max_retries + the final fatal trip
+    assert c["escalations"] >= 1
+    assert seen and seen[0]["nt_chunk"] < 8  # hook saw the shrunk chunk
+
+
+@pytest.mark.faults
+def test_elastic_restart_requires_checkpoint_dir():
+    _init()
+    step, state = _diffusion_step()
+    with pytest.raises(ResilienceError, match="no checkpoint_dir"):
+        igg.run_resilient(step, state, 10, nt_chunk=5, key="resil_nockpt",
+                          faults=[igg.ProcessLoss(step=5,
+                                                  new_dims=(1, 2, 2))])
+
+
+# ---------------------------------------------------------------------------
+# Fault primitives
+# ---------------------------------------------------------------------------
+
+def test_poke_nan_targets_one_cell():
+    _init()
+    T = igg.ones_g()
+    T2 = igg.poke_nan(T, (3, 4, 5))
+    h = np.asarray(T2)
+    assert np.isnan(h[3, 4, 5]) and np.isfinite(np.delete(h.ravel(),
+                                                          np.ravel_multi_index((3, 4, 5), h.shape))).all()
+
+
+def test_corrupt_checkpoint_validation(tmp_path):
+    _init()
+    d = str(tmp_path / "ck")
+    igg.save_checkpoint_sharded(d, {"A": igg.ones_g()})
+    with pytest.raises(InvalidArgumentError, match="kind"):
+        igg.corrupt_checkpoint(d, kind="nope")
+    with pytest.raises(InvalidArgumentError, match="target"):
+        igg.corrupt_checkpoint(d, target="nope")
+    with pytest.raises(InvalidArgumentError, match="no such"):
+        igg.corrupt_checkpoint(str(tmp_path / "missing"))
