@@ -143,6 +143,15 @@ def main() -> None:
     for row in bench_resilience.run_guard_overhead(dims3, cpu):
         results.append(bench_util.emit(row))
 
+    # --- telemetry overhead (flight recorder + metrics on vs off) ----------
+    # the observability layer's host-side cost per supervised run as a
+    # fraction of run time; target < 2% (ISSUE 3). Config owned by
+    # `bench_telemetry.run_telemetry_overhead` (shared with the standalone).
+    import bench_telemetry
+
+    for row in bench_telemetry.run_telemetry_overhead(dims3, cpu):
+        results.append(bench_util.emit(row))
+
     # --- pseudo-transient Stokes 3-D (BASELINE config 5) -------------------
     nxs, nts = (24, 20) if cpu else (128, 300)
     igg.init_global_grid(nxs, nxs, nxs, dimx=dims3[0], dimy=dims3[1],

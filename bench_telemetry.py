@@ -1,0 +1,187 @@
+"""Benchmark: telemetry overhead of the supervised driver.
+
+The observability layer (ISSUE 3) instruments the resilient driver's
+per-chunk host path — flight-recorder JSONL events (run/chunk/cache
+records, flushed per line), metrics-registry counter bumps, and the
+runner-cache notes — all strictly host-side (the HLO-level guarantee that
+the chunk PROGRAM is unchanged lives in tests/test_hlo_audit.py). This leg
+bounds what that instrumentation costs at the driver's operating point,
+against the <2% gate (ISSUE 3 acceptance), with two measurements:
+
+- ``value`` (gated): the DETERMINISTIC accounting — the microbenchmarked
+  cost of one flushed recorder event (including its registry bumps and
+  the open/close amortized) times the events a supervised run actually
+  emits, over the run's median telemetry-off time. This measures the
+  exact marginal work telemetry adds, reproducibly.
+- ``ab_median_frac`` (corroboration): an end-to-end telemetry-on vs
+  telemetry-off `run_resilient` A/B — alternating-order interleaved
+  pairs, median of the per-pair fractional differences. On the shared
+  CPU mesh the per-run jitter (±30-100% observed, `ab_noise_iqr`) is
+  orders of magnitude above the ~0.1% signal, so this corroborates that
+  the cost is lost in the noise rather than resolving it; on quiet
+  hardware the two figures converge.
+
+Like the guard-overhead leg (bench_resilience.py) this is INCLUSIVE
+per-chunk cost, not a two-point slope: the overhead is per-chunk fixed,
+which a slope over two window sizes would cancel by construction.
+
+Usage: python bench_telemetry.py          (real chip)
+       python bench_telemetry.py --cpu    (8-device virtual CPU mesh)
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import tempfile
+
+import bench_util
+
+
+def telemetry_overhead_rows(nx: int, nt_chunk: int, n_chunks: int = 3,
+                            reps: int = 10):
+    """One row on the CURRENT grid (caller owns init/finalize): the
+    telemetry overhead fraction of a supervised run (see module
+    docstring for the two estimators)."""
+    import statistics
+    import time
+
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import (
+        diffusion_step_local, init_diffusion3d,
+    )
+
+    T, Cp, p = init_diffusion3d(dtype=np.float32)
+
+    def step(s):
+        return {"T": diffusion_step_local(s["T"], s["Cp"], p, "xla"),
+                "Cp": s["Cp"]}
+
+    state = {"T": T, "Cp": Cp}
+    nt = nt_chunk * n_chunks
+    key = ("bench_telemetry", nx, nt_chunk)
+    tmp = tempfile.mkdtemp(prefix="igg_bench_tel_")
+    seq = itertools.count()
+
+    def run_off():
+        igg.run_resilient(step, state, nt, nt_chunk=nt_chunk, key=key)
+
+    def run_on():
+        igg.start_flight_recorder(
+            os.path.join(tmp, f"run{next(seq)}.jsonl"))
+        try:
+            igg.run_resilient(step, state, nt, nt_chunk=nt_chunk, key=key)
+        finally:
+            igg.stop_flight_recorder()
+
+    # warm: compile once (shared key), first JSONL file created
+    run_off()
+    run_on()
+
+    # --- end-to-end A/B (corroboration) --------------------------------
+    # alternating-order interleaved pairs cancel position bias; the
+    # median of pair diffs is the only estimator that does not turn into
+    # a coin flip at a sub-0.1% effect under multi-10% machine jitter
+    times = {"off": [], "on": []}
+    pair_fracs = []
+    for r in range(reps):
+        order = [(run_off, "off"), (run_on, "on")] if r % 2 == 0 \
+            else [(run_on, "on"), (run_off, "off")]
+        d = {}
+        for fn, slot in order:
+            igg.tic()
+            fn()
+            d[slot] = igg.toc()
+            times[slot].append(d[slot])
+        pair_fracs.append((d["on"] - d["off"]) / d["off"])
+    pair_fracs.sort()
+    iqr = (pair_fracs[(3 * len(pair_fracs)) // 4]
+           - pair_fracs[len(pair_fracs) // 4])
+
+    # --- deterministic accounting (the gated figure) -------------------
+    # one flushed event write (registry bumps included via the same
+    # hooks), open/close amortized over the probe; scaled by the events a
+    # real run emits over the run's median telemetry-off time
+    n_events = len(igg.read_flight_events(
+        os.path.join(tmp, "run0.jsonl")))
+    probe = os.path.join(tmp, "probe.jsonl")
+    n_probe = 2000
+    t0 = time.monotonic()
+    igg.start_flight_recorder(probe)
+    for i in range(n_probe):
+        igg.record_event("chunk", chunk=i, step_begin=0, step_end=nt_chunk,
+                         n=nt_chunk, ok=True, reasons=[], build_s=1e-3,
+                         exec_s=0.1)
+    igg.stop_flight_recorder()
+    per_event_s = (time.monotonic() - t0) / n_probe
+    t_off_med = statistics.median(times["off"])
+    accounted = per_event_s * n_events / t_off_med
+
+    return [{
+        "metric": "telemetry_overhead_frac",
+        "value": accounted,
+        "unit": "fraction of run time, deterministic per-event accounting "
+                "(target < 0.02)",
+        "target": 0.02,
+        "nt": nt,
+        "nt_chunk": nt_chunk,
+        "events_per_run": n_events,
+        "per_event_write_s": per_event_s,
+        "off_run_s_median": t_off_med,
+        "on_run_s_median": statistics.median(times["on"]),
+        "ab_median_frac": statistics.median(pair_fracs),
+        "ab_noise_iqr": iqr,
+        "note": "ab_median_frac is the end-to-end A/B (median of "
+                "alternating interleaved pairs); on the shared-CPU mesh "
+                "its noise floor (ab_noise_iqr) sits far above the "
+                "accounted cost, corroborating the gate rather than "
+                "resolving it",
+    }]
+
+
+def run_telemetry_overhead(dims, cpu: bool):
+    """The canonical leg: init its own grid over ``dims``, measure,
+    finalize, return the rows. Shared by this script's __main__ and
+    `bench_all.py` so the config stays in ONE place."""
+    import implicitglobalgrid_tpu as igg
+
+    # per-chunk fixed cost: chunks long enough that call jitter does not
+    # swamp the sub-1% signal (same sizing rationale as bench_resilience)
+    nx, nt_chunk = (32, 60) if cpu else (256, 200)
+    igg.init_global_grid(nx, nx, nx, dimx=dims[0], dimy=dims[1],
+                         dimz=dims[2], periodx=1, periody=1, periodz=1,
+                         quiet=True)
+    try:
+        return telemetry_overhead_rows(nx, nt_chunk)
+    finally:
+        igg.finalize_global_grid()
+
+
+def main() -> None:
+    cpu = "--cpu" in sys.argv
+    if cpu:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    import implicitglobalgrid_tpu as igg
+
+    nd = len(jax.devices())
+    dims = tuple(int(d) for d in igg.dims_create(nd, (0, 0, 0)))
+    for row in run_telemetry_overhead(dims, cpu):
+        bench_util.emit(row)
+
+
+if __name__ == "__main__":
+    if bench_util.is_child():
+        main()
+    else:
+        bench_util.run_with_retries("telemetry_overhead_frac", "fraction")

@@ -38,7 +38,9 @@ from .parallel.topology import (
     global_grid, get_global_grid, grid_is_initialized, check_initialized,
     neighbors_table, ol, dims_create,
 )
-from .ops.halo import update_halo, local_update_halo, DEFAULT_DIMS_ORDER
+from .ops.halo import (
+    update_halo, local_update_halo, halo_comm_plan, DEFAULT_DIMS_ORDER,
+)
 from .ops.overlap import hide_communication
 from .ops.gather import gather, gather_interior, gather_sub
 from .ops.alloc import zeros_g, ones_g, full_g, device_put_g, sharding_of
@@ -63,6 +65,12 @@ from .runtime import (
     NaNPoke, CheckpointCorruption, ProcessLoss,
     poke_nan, corrupt_checkpoint, elastic_restart,
 )
+from .telemetry import (
+    MetricsRegistry, metrics_registry, reset_metrics, prometheus_snapshot,
+    FlightRecorder, start_flight_recorder, stop_flight_recorder,
+    flight_recorder, record_event, record_span, read_flight_events,
+    run_report,
+)
 from .utils import exceptions
 
 __version__ = "0.1.0"
@@ -85,6 +93,11 @@ __all__ = [
     "NaNPoke", "CheckpointCorruption", "ProcessLoss",
     "poke_nan", "corrupt_checkpoint", "elastic_restart",
     "health_counters", "record_health_event", "reset_health_counters",
+    # telemetry (metrics registry, flight recorder, exporters, run report)
+    "MetricsRegistry", "metrics_registry", "reset_metrics",
+    "prometheus_snapshot", "FlightRecorder", "start_flight_recorder",
+    "stop_flight_recorder", "flight_recorder", "record_event",
+    "record_span", "read_flight_events", "run_report", "halo_comm_plan",
     "d_xa", "d_ya", "d_za", "d_xi", "d_yi", "d_zi", "inn",
     "stochastic_round_bf16",
     # state/introspection

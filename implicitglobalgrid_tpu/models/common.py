@@ -146,8 +146,12 @@ def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
     via `overlap_stats`/`op_breakdown` on a v5e trace); an unrolled body
     ping-pongs intermediate buffers and pays that copy once per ``unroll``
     steps (`lax.fori_loop` handles non-divisible trip counts)."""
+    import time
+
     import jax
     from jax import lax
+
+    from ..telemetry import note_runner_cache
 
     check_initialized()
     gg = global_grid()
@@ -156,6 +160,7 @@ def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
     if unroll is None:
         unroll = 4 if gg.device_type == "tpu" else 1
     unroll = max(1, min(int(unroll), int(nt_chunk)))
+    t_build0 = time.monotonic()
     if key is not None:
         # kernel_flags are read at TRACE time inside the kernel builders;
         # keying on them keeps the documented IGG_MP_HANDOFF /
@@ -176,6 +181,9 @@ def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
                     str(resolve_wire_dtype(None)), hook_id)
         fn = _runner_cache.get(full_key)
         if fn is not None:
+            # telemetry: compiled-chunk reuse vs recompile is THE
+            # execute/compile split the flight recorder attributes chunks to
+            note_runner_cache("hit")
             return fn
         if _runner_cache and next(iter(_runner_cache))[0] != gg.epoch:
             _runner_cache.clear()
@@ -206,6 +214,11 @@ def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
     ))
     if key is not None:
         _runner_cache[full_key] = fn
+    # build_s is host-side program construction; the XLA compile itself is
+    # paid inside the FIRST dispatch of this runner (a chunk following a
+    # `miss` is a cold chunk — `telemetry.run_report` joins the two)
+    note_runner_cache("miss" if key is not None else "uncached",
+                      build_s=time.monotonic() - t_build0)
     return fn
 
 

@@ -73,7 +73,7 @@ from .fields import (
 from .precision import resolve_wire_dtype, wire_dtype_for
 
 __all__ = ["update_halo", "local_update_halo", "free_update_halo_caches",
-           "halo_may_use_pallas", "resolve_halo_coalesce",
+           "halo_may_use_pallas", "resolve_halo_coalesce", "halo_comm_plan",
            "DEFAULT_DIMS_ORDER"]
 
 # Reference default `dims=(3,1,2)` (1-based: z, x, y — update_halo.jl:29).
@@ -85,11 +85,16 @@ DEFAULT_DIMS_ORDER = (2, 0, 1)
 # across calls, freed by `finalize_global_grid`.
 _exchange_cache: dict = {}
 
+# Static wire plans keyed like the exchange cache (telemetry comm
+# accounting: computed once per signature, charged per call).
+_plan_cache: dict = {}
+
 
 def free_update_halo_caches() -> None:
     """Drop compiled exchange programs (analog of
     `free_update_halo_buffers`, reference `update_halo.jl:103-108`)."""
     _exchange_cache.clear()
+    _plan_cache.clear()
 
 
 def halo_may_use_pallas(gg=None) -> bool:
@@ -668,6 +673,166 @@ def _build_exchange_fn(gg, sig, dims_order, coalesce, wire):
     return jax.jit(shmapped)
 
 
+class _SigField:
+    """Shape/dtype stand-in for a field signature entry, so the routing
+    helpers (`_coalesce_groups`, `_dim_exchanges`) serve the static wire
+    plan without real arrays."""
+
+    __slots__ = ("shape", "dtype", "ndim")
+
+    def __init__(self, shape, dtype):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self.ndim = len(self.shape)
+
+
+def _plan_from_sig(gg, sig, dims_order, coalesce, wire) -> dict:
+    """Static comm accounting for one exchange signature: collective
+    counts and bytes-on-wire derived purely from shapes/overlaps/wire
+    dtype — no tracing, no device work (the TPU analog of the reference's
+    printed GB/s estimate, computed instead of measured).
+
+    The wire pattern is invariant across kernel tiers (Pallas unpack,
+    combined one-pass, plain `dynamic_update_slice` all consume the SAME
+    permuted slabs), so the plan only branches on what actually changes
+    the wire: coalescing (one packed ppermute pair per (axis, dtype
+    group) instead of one pair per field) and the wire dtype (narrowed
+    payloads). ``wire_bytes`` sums the payload over every source->dest
+    link of the permute (all shards), both directions;
+    ``local_copy_bytes`` counts self-neighbor slab swaps that never touch
+    the interconnect."""
+    fields = [_SigField(shape, dt) for (shape, dt, _) in sig]
+    hws = [tuple(int(h) for h in hw) for (_, _, hw) in sig]
+
+    def slab_cells(i, dim):
+        shp = fields[i].shape
+        return int(np.prod(shp)) // shp[dim] * hws[i][dim]
+
+    axes: dict = {}
+
+    def axis_rec(dim):
+        return axes.setdefault(
+            AXIS_NAMES[dim], {"ppermutes": 0, "wire_bytes": 0,
+                              "by_dtype": {}})
+
+    def add_wire(dim, cells, dtype, npairs):
+        rec = axis_rec(dim)
+        rec["ppermutes"] += 2
+        b = cells * np.dtype(dtype).itemsize * npairs
+        rec["wire_bytes"] += b
+        key = str(np.dtype(dtype))
+        rec["by_dtype"][key] = rec["by_dtype"].get(key, 0) + b
+
+    local_bytes = 0
+    groups_by_dim = _coalesce_groups(
+        gg, fields, hws, [False] * len(fields), dims_order) \
+        if coalesce else {}
+    for dim in dims_order:
+        D, periodic, disp = _dim_meta(gg, dim)
+        if D == 1 and not periodic:
+            continue
+        perm_p, perm_m = _perm_pairs(D, periodic, disp)
+        npairs = len(perm_p) + len(perm_m)
+        in_group = set()
+        for g in groups_by_dim.get(dim, ()):  # groups only form on D>1 axes
+            in_group.update(g)
+            f0 = fields[g[0]]
+            wd = wire_dtype_for(f0.dtype, wire) or f0.dtype
+            add_wire(dim, sum(slab_cells(i, dim) for i in g), wd, npairs)
+        for i, f in enumerate(fields):
+            if i in in_group or not _dim_exchanges(gg, f.shape, hws[i], dim):
+                continue
+            if D == 1:  # periodic self-neighbor: local slab swap, no wire
+                local_bytes += 2 * slab_cells(i, dim) * f.dtype.itemsize
+                continue
+            wd = wire_dtype_for(f.dtype, wire) or f.dtype
+            add_wire(dim, slab_cells(i, dim), wd, npairs)
+    return {
+        "fields": len(fields),
+        "coalesce": bool(coalesce),
+        "wire_dtype": None if wire is None else str(np.dtype(wire)),
+        "axes": axes,
+        "ppermutes": sum(r["ppermutes"] for r in axes.values()),
+        "wire_bytes": sum(r["wire_bytes"] for r in axes.values()),
+        "local_copy_bytes": local_bytes,
+    }
+
+
+def _normalized_fields(fields):
+    """`update_halo`'s argument normalization: ``(A, hw)`` tuples ->
+    `Field`, pytrees exploded (reference `update_halo.jl:31-32`), ndim
+    and per-field coherence validated."""
+    fs = []
+    for f in fields:
+        if isinstance(f, tuple) and not isinstance(f, Field) and len(f) == 2 \
+                and hasattr(f[0], "shape") and not hasattr(f[1], "shape"):
+            fs.append(wrap_field(f[0], f[1]))
+        else:
+            fs.extend(wrap_field(x) for x in extract(f))
+    if not fs:
+        raise InvalidArgumentError("update_halo requires at least one field.")
+    for f in fs:
+        if not hasattr(f.A, "shape"):
+            raise InvalidArgumentError("update_halo requires array inputs.")
+        if not (1 <= f.A.ndim <= NDIMS):
+            raise InvalidArgumentError(
+                f"update_halo supports 1-D to {NDIMS}-D arrays; got {f.A.ndim}-D."
+            )
+    check_fields(fs)
+    return fs
+
+
+def _stacked_sig(gg, fs) -> tuple:
+    """The exchange signature of normalized fields: LOCAL shapes (stacked
+    sizes divided by ``dims`` — validated even), dtype strings, halowidths.
+
+    Dtypes are CANONICALIZED the way ``jnp.asarray`` will canonicalize the
+    arrays (x64-disabled jax demotes f64 -> f32), so the signature — and
+    everything keyed on it: the compiled-exchange cache, the wire plan —
+    always describes the arrays actually exchanged."""
+    import jax
+
+    for f in fs:
+        for d in range(f.A.ndim):
+            if int(f.A.shape[d]) % int(gg.dims[d]) != 0:
+                raise IncoherentArgumentError(
+                    f"Global (stacked) array size {f.A.shape[d]} along dimension {d} is not "
+                    f"divisible by dims[{d}]={int(gg.dims[d])}. update_halo operates on "
+                    "stacked global arrays (dims * local size); see local_update_halo for "
+                    "the local view."
+                )
+    return tuple(
+        (
+            tuple(int(s) // int(gg.dims[d]) for d, s in enumerate(f.A.shape)),
+            str(jax.dtypes.canonicalize_dtype(np.dtype(f.A.dtype))),
+            tuple(int(h) for h in f.halowidths),
+        )
+        for f in fs
+    )
+
+
+def halo_comm_plan(*fields, dims=None, coalesce=None, wire_dtype=None) -> dict:
+    """Static bytes-on-wire / collective-count plan for an `update_halo`
+    call with these stacked fields — derived from shapes, overlaps, and
+    the wire dtype alone; nothing is compiled or dispatched (zero device
+    syncs). Fields accept the same forms as `update_halo` (arrays,
+    `Field`, ``(A, hw)`` tuples, pytrees) and anything with
+    ``shape``/``dtype`` (e.g. `jax.ShapeDtypeStruct`) works.
+
+    Returns ``{fields, coalesce, wire_dtype, axes: {axis: {ppermutes,
+    wire_bytes, by_dtype}}, ppermutes, wire_bytes, local_copy_bytes}``.
+    `update_halo` charges exactly this plan to the telemetry registry
+    (``igg_halo_*`` counters) on every call."""
+    check_initialized()
+    gg = global_grid()
+    dims_order = _normalize_dims_order(dims)
+    fs = _normalized_fields(fields)
+    sig = _stacked_sig(gg, fs)
+    return _plan_from_sig(gg, sig, dims_order,
+                          resolve_halo_coalesce(coalesce),
+                          resolve_wire_dtype(wire_dtype))
+
+
 def update_halo(*fields, dims=None, coalesce=None, wire_dtype=None):
     """Update the halo of the given global (stacked) array(s).
 
@@ -707,46 +872,12 @@ def update_halo(*fields, dims=None, coalesce=None, wire_dtype=None):
     gg = global_grid()
     dims_order = _normalize_dims_order(dims)
 
-    # Normalize: tuples (A, hw) → Field; pytrees exploded (reference :31-32).
-    fs = []
-    for f in fields:
-        if isinstance(f, tuple) and not isinstance(f, Field) and len(f) == 2 \
-                and hasattr(f[0], "shape") and not hasattr(f[1], "shape"):
-            fs.append(wrap_field(f[0], f[1]))
-        else:
-            fs.extend(wrap_field(x) for x in extract(f))
-    if not fs:
-        raise InvalidArgumentError("update_halo requires at least one field.")
-    for f in fs:
-        if not hasattr(f.A, "shape"):
-            raise InvalidArgumentError("update_halo requires array inputs.")
-        if not (1 <= f.A.ndim <= NDIMS):
-            raise InvalidArgumentError(
-                f"update_halo supports 1-D to {NDIMS}-D arrays; got {f.A.ndim}-D."
-            )
-    check_fields(fs)
-
-    # Validate the stacked layout: every sharded dim must divide evenly.
-    for f in fs:
-        for d in range(f.A.ndim):
-            if int(f.A.shape[d]) % int(gg.dims[d]) != 0:
-                raise IncoherentArgumentError(
-                    f"Global (stacked) array size {f.A.shape[d]} along dimension {d} is not "
-                    f"divisible by dims[{d}]={int(gg.dims[d])}. update_halo operates on "
-                    "stacked global arrays (dims * local size); see local_update_halo for "
-                    "the local view."
-                )
-
+    # Normalize (tuples (A, hw) → Field; pytrees exploded, reference :31-32)
+    # and validate the stacked layout: every sharded dim must divide evenly.
+    fs = _normalized_fields(fields)
     arrays = [jnp.asarray(f.A) for f in fs]
     # Signature uses LOCAL shapes: the exchange math runs on per-shard blocks.
-    sig = tuple(
-        (
-            tuple(int(s) // int(gg.dims[d]) for d, s in enumerate(a.shape)),
-            str(a.dtype),
-            tuple(int(h) for h in f.halowidths),
-        )
-        for a, f in zip(arrays, fs)
-    )
+    sig = _stacked_sig(gg, fs)
     coalesce_r = resolve_halo_coalesce(coalesce)
     wire_r = resolve_wire_dtype(wire_dtype)
     key = (grid_epoch(), sig, dims_order, _FORCE_PALLAS_WRITE_INTERPRET,
@@ -755,5 +886,14 @@ def update_halo(*fields, dims=None, coalesce=None, wire_dtype=None):
     if fn is None:
         fn = _build_exchange_fn(gg, sig, dims_order, coalesce_r, wire_r)
         _exchange_cache[key] = fn
+    # Static comm accounting: charge the signature's wire plan per call
+    # (computed once per signature, pure host arithmetic — no syncs).
+    plan = _plan_cache.get(key)
+    if plan is None:
+        plan = _plan_from_sig(gg, sig, dims_order, coalesce_r, wire_r)
+        _plan_cache[key] = plan
+    from ..telemetry import account_halo_exchange
+
+    account_halo_exchange(plan)
     out = fn(*arrays)
     return out[0] if len(out) == 1 else tuple(out)

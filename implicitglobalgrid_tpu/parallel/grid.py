@@ -245,10 +245,11 @@ def finalize_global_grid(*, finalize_dist: bool = False) -> None:
 
     top.check_initialized()
     from ..ops.halo import free_update_halo_caches
-    from ..utils.timing import _probe_cache
+    from ..utils import timing
 
     free_update_halo_caches()
-    _probe_cache.clear()
+    timing._probe_cache.clear()
+    timing._t0 = None  # a chronometer from a dead grid epoch is meaningless
     if finalize_dist:
         import jax
 

@@ -30,7 +30,15 @@ doubles as the chunk drain), builds a `HealthReport`, and
 
 Every recovery path is exercised deterministically by the fault-injection
 species of `runtime/faults.py` in tier-1 tests. Counters for each event
-kind land in `utils.profiling.health_counters()`.
+kind land in the telemetry metrics registry (`igg_health_events_total`;
+`utils.profiling.health_counters()` remains as a shim), and with an active
+flight recorder (`igg.start_flight_recorder`) the driver streams its whole
+lifecycle — chunk execute/compile splits, guard trips, rollback/restore
+latencies, escalations, elastic restarts — as JSONL events that
+`igg.run_report` reconstructs post-hoc. All instrumentation is host-side:
+the compiled chunk program is bit-identical with telemetry on or off
+(`tests/test_hlo_audit.py`) and the measured overhead sits under the 2%
+gate (`bench_telemetry.py`).
 """
 
 from __future__ import annotations
@@ -160,6 +168,7 @@ def run_resilient(step_local, state: dict, nt: int, *,
     import numpy as np
 
     from ..parallel.topology import check_initialized
+    from ..telemetry import record_event
     from ..utils import profiling
     from ..utils.exceptions import InvalidArgumentError, ResilienceError
     from ..utils.timing import sync
@@ -201,6 +210,9 @@ def run_resilient(step_local, state: dict, nt: int, *,
                     f"{f.name!r} of stacked shape {tuple(shape)}.")
     slots = (_CheckpointSlots(checkpoint_dir)
              if checkpoint_dir is not None else None)
+    record_event("run_begin", nt=nt, nt_chunk=cur_chunk,
+                 checkpoint_every=checkpoint_every, names=names,
+                 checkpointing=slots is not None, faults=len(pending))
 
     def step_tuple(tup):
         out = step_local(dict(zip(names, tup)))
@@ -223,6 +235,9 @@ def run_resilient(step_local, state: dict, nt: int, *,
                and f.save_index == saves]
         for f in due:
             pending.remove(f)
+            record_event("fault_injected", fault="CheckpointCorruption",
+                         save_index=f.save_index, corruption=f.kind,
+                         target=f.target)
             # one damage event, not one per process: applied by process 0
             # only (a second bitflip would undo the first; a second delete
             # would race-crash), made visible to all before anyone reads
@@ -263,16 +278,22 @@ def run_resilient(step_local, state: dict, nt: int, *,
             pending.remove(f)
             state = dict(state)
             state[f.name] = poke_nan(state[f.name], f.index)
+            record_event("fault_injected", fault="NaNPoke", step=f.step,
+                         name=f.name)
         loss = next((f for f in pending
                      if isinstance(f, ProcessLoss) and f.step == step), None)
         if loss is not None:
             pending.remove(loss)
+            record_event("fault_injected", fault="ProcessLoss",
+                         step=loss.step, new_dims=list(loss.new_dims))
             if slots is None:
                 raise ResilienceError(
                     "ProcessLoss injected with no checkpoint_dir — "
                     "nothing to restart from.")
             state, step = _elastic_recover(loss.new_dims)
             profiling.record_health_event("elastic_restarts")
+            record_event("elastic_restart", new_dims=list(loss.new_dims),
+                         to_step=step)
             # re-anchor the slots on the NEW decomposition right away, so
             # a guard trip before the next cadence save rolls back onto
             # the live grid instead of re-crossing the dims change
@@ -290,17 +311,26 @@ def run_resilient(step_local, state: dict, nt: int, *,
 
         ndims = tuple(state[k].ndim for k in names)
         sizes = [int(np.prod(state[k].shape)) for k in names]
+        t_build0 = time.monotonic()
         runner = make_guarded_runner(
             step_tuple, ndims, nt_chunk=n,
             key=None if key is None else (key, "resilient"),
             check_vma=check_vma, unroll=unroll)
+        t_exec0 = time.monotonic()
         out = runner(*(state[k] for k in names))
         vec = np.asarray(out[-1])  # tiny replicated fetch = the chunk drain
+        t_done = time.monotonic()
         rep = report_from_stats(vec, names, sizes, guard, chunk=chunk_idx,
                                 step_begin=step, step_end=nb)
         chunk_idx += 1
         reports.append(rep)
         profiling.record_health_event("chunks")
+        # exec_s covers dispatch through the stats fetch (= the chunk
+        # drain); a chunk right after a runner-cache miss also pays the
+        # XLA compile inside it — run_report flags those chunks as cold.
+        record_event("chunk", chunk=rep.chunk, step_begin=step, step_end=nb,
+                     n=n, ok=rep.ok, reasons=list(rep.reasons),
+                     build_s=t_exec0 - t_build0, exec_s=t_done - t_exec0)
         if on_report is not None:
             on_report(rep)
 
@@ -308,13 +338,19 @@ def run_resilient(step_local, state: dict, nt: int, *,
             state = dict(zip(names, out[:-1]))
             step = nb
             retries = 0
-            if slots is not None and step % checkpoint_every == 0:
+            # cadence saves, plus the TERMINAL state: without the latter a
+            # run whose nt is off-cadence could never be resumed from its
+            # own end (it would replay from the last cadence save)
+            if slots is not None and (step % checkpoint_every == 0
+                                      or step >= nt):
                 _save(state, step)
             continue
 
         # --- guard tripped: bounded-retry rollback -----------------------
         profiling.record_health_event("guard_trips")
         retries += 1
+        record_event("guard_trip", step_end=nb, reasons=list(rep.reasons),
+                     retries=retries)
         if slots is None:
             raise ResilienceError(
                 f"Health guard tripped at step {nb} "
@@ -331,6 +367,8 @@ def run_resilient(step_local, state: dict, nt: int, *,
                 and cur_chunk > policy.min_nt_chunk:
             cur_chunk = max(policy.min_nt_chunk, cur_chunk // 2)
             profiling.record_health_event("escalations")
+            record_event("escalation", retries=retries, nt_chunk=cur_chunk,
+                         step=step)
             if policy.on_escalate is not None:
                 policy.on_escalate({"retries": retries,
                                     "nt_chunk": cur_chunk, "step": step})
@@ -339,5 +377,8 @@ def run_resilient(step_local, state: dict, nt: int, *,
         profiling.record_health_event("restores")
         if fellback:
             profiling.record_health_event("restore_fallbacks")
+        record_event("rollback", to_step=step, fallback=fellback,
+                     retries=retries)
 
+    record_event("run_end", completed=step, chunks=chunk_idx)
     return sync(state), reports

@@ -1,0 +1,47 @@
+"""Run telemetry: metrics registry, flight recorder, exporters, run report.
+
+The observability subsystem (ISSUE 3 tentpole) — every run becomes
+structured, exportable data instead of a `tic`/`toc` printout (the
+reference's whole surface, SURVEY §5.4):
+
+- `registry` — process-local, thread-safe metric families (counters,
+  gauges, fixed-bucket histograms) with labels; absorbs PR-2's
+  `health_counters` (kept as a shim in `utils.profiling`).
+- `recorder` — the span/event flight recorder: one append-only JSONL
+  stream per run (monotonic timestamps, pid/process index, run id),
+  streamed by `runtime/driver.py`, the runner caches, and the
+  checkpoint layer.
+- `hooks` — the metric-name contract the framework's hot paths call
+  (runner-cache outcomes, static halo comm accounting, checkpoint
+  latencies).
+- `export` — Prometheus text-format snapshots.
+- `report` — `run_report`: the unified record merging the flight log
+  with `overlap_stats`/`op_breakdown`; also the `python -m
+  implicitglobalgrid_tpu.tools report` CLI's engine.
+
+All instrumentation is HOST-side: compiled chunk programs are unchanged
+(`tests/test_hlo_audit.py` proves identical collective and fetch counts)
+and the measured overhead sits under the 2% gate (`bench_telemetry.py`).
+"""
+
+from .export import prometheus_snapshot
+from .hooks import account_halo_exchange, note_runner_cache, \
+    observe_checkpoint
+from .recorder import (
+    FlightRecorder, flight_recorder, read_flight_events, record_event,
+    record_span, start_flight_recorder, stop_flight_recorder,
+)
+from .registry import (
+    DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry,
+    metrics_registry, reset_metrics,
+)
+from .report import run_report
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "DEFAULT_BUCKETS",
+    "metrics_registry", "reset_metrics",
+    "FlightRecorder", "start_flight_recorder", "stop_flight_recorder",
+    "flight_recorder", "record_event", "record_span", "read_flight_events",
+    "prometheus_snapshot", "run_report",
+    "note_runner_cache", "account_halo_exchange", "observe_checkpoint",
+]

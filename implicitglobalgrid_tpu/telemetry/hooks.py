@@ -1,0 +1,85 @@
+"""Framework-side instrumentation hooks: the one place the hot paths call.
+
+Each hook bumps the process metrics registry (always on — a few dict ops
+under a lock) and appends a flight-recorder event when a recorder is
+active (a no-op None-check otherwise). Keeping the metric names and label
+sets here, instead of scattered over `ops/halo.py` / `models/common.py` /
+`utils/checkpoint.py`, means the exported surface is greppable in one
+module and a rename can never desynchronize producers.
+"""
+
+from __future__ import annotations
+
+from .recorder import record_event
+from .registry import metrics_registry
+
+__all__ = ["note_runner_cache", "account_halo_exchange",
+           "observe_checkpoint"]
+
+# Metric family names (the exported contract; see docs/observability.md).
+RUNNER_CACHE = "igg_runner_cache_total"
+HALO_EXCHANGES = "igg_halo_exchanges_total"
+HALO_PPERMUTES = "igg_halo_ppermutes_total"
+HALO_WIRE_BYTES = "igg_halo_wire_bytes_total"
+HALO_LOCAL_BYTES = "igg_halo_local_copy_bytes_total"
+CKPT_SECONDS = "igg_checkpoint_seconds"
+
+
+def note_runner_cache(result: str, build_s: float | None = None) -> None:
+    """Record a `make_state_runner` cache outcome: ``hit`` (compiled chunk
+    reused), ``miss`` (new program built — the following dispatch pays the
+    XLA compile), or ``uncached`` (no key given)."""
+    metrics_registry().counter(
+        RUNNER_CACHE,
+        "Chunk-runner cache outcomes (miss = the next dispatch compiles).",
+        ("result",)).inc(1, result=result)
+    if build_s is None:
+        record_event("runner_cache", result=result)
+    else:
+        record_event("runner_cache", result=result, build_s=build_s)
+
+
+def account_halo_exchange(plan: dict) -> None:
+    """Record one `update_halo` call from its static wire plan
+    (`ops.halo.halo_comm_plan`): bytes-on-wire and collective counts per
+    mesh axis, derived at trace time from shapes/overlaps/wire dtype —
+    zero device syncs (the TPU analog of the reference's printed GB/s
+    estimate, computed instead of measured)."""
+    reg = metrics_registry()
+    reg.counter(HALO_EXCHANGES, "update_halo calls accounted.").inc(1)
+    pperm = reg.counter(
+        HALO_PPERMUTES,
+        "collective-permute ops issued by halo exchanges, per mesh axis.",
+        ("axis",))
+    wire = reg.counter(
+        HALO_WIRE_BYTES,
+        "Halo payload bytes crossing the interconnect (all links summed), "
+        "per mesh axis and on-wire dtype.", ("axis", "dtype"))
+    for axis, rec in plan["axes"].items():
+        if rec["ppermutes"]:
+            pperm.inc(rec["ppermutes"], axis=axis)
+        for dt, b in rec["by_dtype"].items():
+            wire.inc(b, axis=axis, dtype=dt)
+    if plan["local_copy_bytes"]:
+        reg.counter(
+            HALO_LOCAL_BYTES,
+            "Halo bytes moved by self-neighbor local copies (no wire)."
+        ).inc(plan["local_copy_bytes"])
+    record_event("halo_exchange", fields=plan["fields"],
+                 ppermutes=plan["ppermutes"],
+                 wire_bytes=plan["wire_bytes"],
+                 local_copy_bytes=plan["local_copy_bytes"])
+
+
+def observe_checkpoint(op: str, dur_s: float, *, path: str,
+                       step=None, **fields) -> None:
+    """Record a checkpoint save/restore latency (``op``: ``save`` |
+    ``save_sharded`` | ``restore`` | ``restore_sharded`` |
+    ``restore_elastic``)."""
+    metrics_registry().histogram(
+        CKPT_SECONDS, "Checkpoint save/restore wall time by operation.",
+        ("op",)).observe(dur_s, op=op)
+    kind = "checkpoint_save" if op.startswith("save") else \
+        "checkpoint_restore"
+    record_event(kind, op=op, dur_s=dur_s, path=str(path), step=step,
+                 **fields)

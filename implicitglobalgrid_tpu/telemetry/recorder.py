@@ -1,0 +1,215 @@
+"""Span/event flight recorder — an append-only JSONL stream per run.
+
+Every supervised run (`runtime/driver.py`) can stream its lifecycle —
+chunk execute/compile splits, checkpoint save/restore/rollback latencies,
+guard trips, escalations, elastic restarts — into one newline-delimited
+JSON file that survives the process (the black-box the reference's
+`tic`/`toc` story has no analog of). Records carry a MONOTONIC timestamp
+``t`` (ordering-safe across NTP steps; the ``recorder_open`` record anchors
+it to wall time), the writer's ``pid`` and jax ``proc``ess index, the run
+id, and a per-recorder sequence number, so a post-hoc reader can
+reconstruct the exact event sequence from the file alone
+(`telemetry.run_report`).
+
+All instrumentation goes through the module-level current recorder::
+
+    igg.start_flight_recorder("/logs/run42.jsonl")
+    state, reports = igg.run_resilient(...)   # driver streams its events
+    path = igg.stop_flight_recorder()
+    report = igg.run_report(path)
+
+`record_event` is a no-op when no recorder is active — the framework's hot
+paths stay instrumented at the cost of one None-check (the <2% overhead
+gate of `bench_telemetry.py` measures the recorder ON). Writes are
+line-buffered and lock-protected (driver callbacks may record from user
+threads); every line is flushed so a crash loses at most the line being
+written, which `read_flight_events` tolerates.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import secrets
+import sys
+import threading
+import time
+
+from ..utils.exceptions import InvalidArgumentError
+
+__all__ = ["FlightRecorder", "start_flight_recorder",
+           "stop_flight_recorder", "flight_recorder", "record_event",
+           "record_span", "read_flight_events"]
+
+_FORMAT_VERSION = 1
+
+
+def _process_index() -> int:
+    """jax process index without forcing a backend init: 0 unless jax is
+    already imported and initialized enough to answer."""
+    j = sys.modules.get("jax")
+    if j is None:
+        return 0
+    try:
+        return int(j.process_index())
+    except Exception:
+        return 0
+
+
+def _jsonable(o):
+    """Fallback encoder for numpy scalars/arrays and everything else.
+    Numeric scalars go through float FIRST (``int(np.float32(0.33))``
+    would silently truncate), demoted back to int when integral."""
+    try:
+        f = float(o)
+    except (TypeError, ValueError):
+        pass
+    else:
+        return int(f) if f.is_integer() and abs(f) < 2.0 ** 53 else f
+    if hasattr(o, "tolist"):
+        return o.tolist()
+    return str(o)
+
+
+class FlightRecorder:
+    """Append-only JSONL event stream for one run.
+
+    ``path`` may be a file path (created/appended) or an existing
+    directory, in which case a ``igg_run_<run_id>.jsonl`` file is created
+    inside it. ``run_id`` defaults to a fresh random token; it tags every
+    record, so several runs can share one file and still be separated by
+    `read_flight_events(path, run_id=...)`."""
+
+    def __init__(self, path, *, run_id: str | None = None):
+        self.run_id = str(run_id) if run_id is not None else \
+            secrets.token_hex(8)
+        path = os.fspath(path)
+        if os.path.isdir(path):
+            path = os.path.join(path, f"igg_run_{self.run_id}.jsonl")
+        self.path = path
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        self._proc = _process_index()
+        self._seq = 0
+        self._f = open(path, "a", encoding="utf-8")
+        self.event("recorder_open", wall=time.time(),
+                   version=_FORMAT_VERSION)
+
+    def event(self, kind: str, **fields) -> None:
+        """Append one record. Reserved keys (``t``, ``kind``, ``run``,
+        ``pid``, ``proc``, ``seq``) always win over ``fields``."""
+        rec = dict(fields)
+        rec["t"] = time.monotonic()
+        rec["kind"] = str(kind)
+        rec["run"] = self.run_id
+        rec["pid"] = self._pid
+        rec["proc"] = self._proc
+        with self._lock:
+            if self._f is None:
+                return  # closed: late events (daemon threads) are dropped
+            rec["seq"] = self._seq
+            self._seq += 1
+            self._f.write(json.dumps(rec, default=_jsonable) + "\n")
+            self._f.flush()
+
+    @contextlib.contextmanager
+    def span(self, kind: str, **fields):
+        """Time the enclosed block and append one record with ``dur_s``."""
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.event(kind, dur_s=time.monotonic() - t0, **fields)
+
+    def close(self) -> None:
+        self.event("recorder_close")
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+_current: FlightRecorder | None = None
+
+
+def start_flight_recorder(path, *, run_id: str | None = None
+                          ) -> FlightRecorder:
+    """Open a `FlightRecorder` and make it THE current recorder — all
+    framework instrumentation (`record_event`) streams into it until
+    `stop_flight_recorder`. An already-active recorder is closed first."""
+    global _current
+    # open the NEW recorder first: a failed open (bad path) must leave the
+    # active recorder recording, not point _current at a closed one
+    new = FlightRecorder(path, run_id=run_id)
+    if _current is not None:
+        _current.close()
+    _current = new
+    return new
+
+
+def stop_flight_recorder() -> str | None:
+    """Close the current recorder; returns its file path (None if no
+    recorder was active)."""
+    global _current
+    if _current is None:
+        return None
+    path = _current.path
+    _current.close()
+    _current = None
+    return path
+
+
+def flight_recorder() -> FlightRecorder | None:
+    """The current recorder, or None."""
+    return _current
+
+
+def record_event(kind: str, **fields) -> None:
+    """Append to the current recorder; no-op (one None-check) when no
+    recorder is active — safe on hot paths."""
+    r = _current
+    if r is not None:
+        r.event(kind, **fields)
+
+
+@contextlib.contextmanager
+def record_span(kind: str, **fields):
+    """Span against the current recorder; when none is active the block
+    runs untimed (no clock reads)."""
+    r = _current
+    if r is None:
+        yield
+        return
+    with r.span(kind, **fields):
+        yield
+
+
+def read_flight_events(path, *, run_id: str | None = None) -> list:
+    """Parse a flight-recorder JSONL file back into a list of dicts, in
+    file order.
+
+    A malformed FINAL line is tolerated (a crash mid-write is exactly the
+    scenario flight recorders exist for); a malformed interior line raises
+    `InvalidArgumentError` (the file was edited or interleaved by a foreign
+    writer). ``run_id`` filters to one run's records."""
+    path = os.fspath(path)
+    if not os.path.exists(path):
+        raise InvalidArgumentError(f"Flight-recorder file not found: {path}")
+    out = []
+    bad_at = None
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            if not line.strip():
+                continue
+            if bad_at is not None:
+                raise InvalidArgumentError(
+                    f"Flight-recorder file {path} has a malformed interior "
+                    f"line {bad_at + 1} — corrupt or foreign content.")
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                bad_at = i  # fatal only if any well-formed line follows
+    if run_id is not None:
+        out = [e for e in out if e.get("run") == str(run_id)]
+    return out

@@ -1,0 +1,177 @@
+"""The unified run report: flight-recorder JSONL -> one structured record.
+
+`run_report` is the single pane of glass the ISSUE-3 tentpole asks for: it
+reconstructs a supervised run's full event sequence (chunks, guard trips,
+rollbacks, checkpoint saves/restores, escalations, elastic restarts) from
+the flight-recorder stream ALONE, and optionally merges the live metrics
+registry plus a profiler capture's `overlap_stats`/`op_breakdown` — so one
+JSON object answers "what happened, what did it cost, and where did the
+time go" for a run that may have died hours ago.
+
+CLI: ``python -m implicitglobalgrid_tpu.tools report run.jsonl
+[--trace DIR] [--run-id ID]``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..utils.exceptions import InvalidArgumentError
+from .recorder import read_flight_events
+from .registry import metrics_registry
+
+__all__ = ["run_report"]
+
+# Event kinds that belong in the reconstructed sequence, with the fields
+# worth carrying (everything else stays in the raw stream).
+_SEQ_FIELDS = {
+    "run_begin": ("nt", "nt_chunk", "checkpoint_every", "names"),
+    "fault_injected": ("fault", "step", "name"),
+    "chunk": ("chunk", "step_begin", "step_end", "ok", "reasons",
+              "build_s", "exec_s", "cold"),
+    "guard_trip": ("step_end", "reasons", "retries"),
+    "escalation": ("retries", "nt_chunk", "step"),
+    "rollback": ("to_step", "fallback"),
+    "checkpoint_save": ("op", "step", "dur_s"),
+    "checkpoint_restore": ("op", "step", "dur_s"),
+    "elastic_restart": ("new_dims", "to_step"),
+    "run_end": ("completed", "chunks"),
+}
+
+
+def _pick(ev: dict, fields: tuple) -> dict:
+    out = {"kind": ev["kind"], "t": ev.get("t")}
+    for f in fields:
+        if f in ev:
+            out[f] = ev[f]
+    return out
+
+
+def run_report(source, *, run_id: str | None = None,
+               trace_dir: str | None = None,
+               include_metrics: bool = True) -> dict:
+    """Build the unified report for one run.
+
+    ``source`` is a flight-recorder JSONL path or an iterable of already-
+    parsed event dicts. ``run_id`` selects a run when the file holds
+    several (default: the LAST run that appears). ``trace_dir`` merges a
+    profiler capture's `overlap_stats` and `op_breakdown`;
+    ``include_metrics`` attaches a snapshot of the process metrics
+    registry (meaningful in-process; the report CLI runs post-hoc, where
+    the registry is empty, and the JSONL carries the truth)."""
+    if isinstance(source, (str, os.PathLike)):
+        events = read_flight_events(source)
+    else:
+        events = list(source)
+    if not events:
+        raise InvalidArgumentError("run_report: no events to report on.")
+
+    runs = []
+    for e in events:
+        r = e.get("run")
+        if r is not None and r not in runs:
+            runs.append(r)
+    rid = str(run_id) if run_id is not None else (runs[-1] if runs else None)
+    if run_id is not None and rid not in runs:
+        raise InvalidArgumentError(
+            f"run_report: run id {rid!r} not present (have {runs}).")
+    evs = [e for e in events if e.get("run") == rid]
+    evs.sort(key=lambda e: (e.get("proc", 0), e.get("seq", 0)))
+
+    # Cold-chunk attribution: a chunk following a runner-cache miss pays
+    # the XLA compile inside its first dispatch — the execute/compile
+    # split the recorder captures without touching the device.
+    pending = None
+    sequence = []
+    chunks, cache = [], {"hits": 0, "misses": 0, "uncached": 0}
+    saves, restores, rollbacks = [], [], []
+    trips, escalations, elastic = [], [], []
+    begin = end = None
+    halo = {"exchanges": 0, "ppermutes": 0, "wire_bytes": 0}
+    for e in evs:
+        k = e.get("kind")
+        if k == "runner_cache":
+            res = e.get("result", "uncached")
+            slot = {"hit": "hits", "miss": "misses"}.get(res, "uncached")
+            cache[slot] = cache.get(slot, 0) + 1
+            pending = res
+            continue
+        if k == "chunk":
+            e = dict(e)
+            e["cold"] = pending == "miss"
+            pending = None
+            chunks.append(e)
+        elif k == "guard_trip":
+            trips.append(e)
+        elif k == "rollback":
+            rollbacks.append(e)
+        elif k == "checkpoint_save":
+            saves.append(e)
+        elif k == "checkpoint_restore":
+            restores.append(e)
+        elif k == "escalation":
+            escalations.append(e)
+        elif k == "elastic_restart":
+            elastic.append(e)
+        elif k == "halo_exchange":
+            halo["exchanges"] += 1
+            halo["ppermutes"] += e.get("ppermutes", 0)
+            halo["wire_bytes"] += e.get("wire_bytes", 0)
+        elif k == "run_begin":
+            begin = e
+        elif k == "run_end":
+            end = e
+        if k in _SEQ_FIELDS:
+            sequence.append(_pick(e, _SEQ_FIELDS[k]))
+
+    reasons: dict = {}
+    for t in trips:
+        for r in t.get("reasons", ()):
+            reasons[r] = reasons.get(r, 0) + 1
+    ok = [c for c in chunks if c.get("ok")]
+    exec_s = [c["exec_s"] for c in chunks if "exec_s" in c]
+    ts = [e["t"] for e in evs if "t" in e]
+
+    report = {
+        "run_id": rid,
+        "n_events": len(evs),
+        "wall_s": (max(ts) - min(ts)) if ts else None,
+        "steps": {
+            "nt": begin.get("nt") if begin else None,
+            "completed": (end.get("completed") if end else
+                          (max((c["step_end"] for c in ok), default=None))),
+        },
+        "chunks": {
+            "count": len(chunks),
+            "ok": len(ok),
+            "tripped": len(chunks) - len(ok),
+            "cold": sum(1 for c in chunks if c.get("cold")),
+            "exec_s_total": sum(exec_s) if exec_s else 0.0,
+            "exec_s_max": max(exec_s) if exec_s else None,
+        },
+        "runner_cache": cache,
+        "guards": {"trips": len(trips), "reasons": reasons},
+        "checkpoints": {
+            "saves": len(saves),
+            "save_s_total": sum(s.get("dur_s", 0.0) for s in saves),
+            "restores": len(restores),
+            "restore_s_total": sum(r.get("dur_s", 0.0) for r in restores),
+            "rollbacks": len(rollbacks),
+        },
+        "escalations": len(escalations),
+        "elastic_restarts": [
+            {"new_dims": e.get("new_dims"), "to_step": e.get("to_step")}
+            for e in elastic],
+        "halo": halo,
+        "sequence": sequence,
+    }
+    if include_metrics:
+        report["metrics"] = metrics_registry().collect()
+    if trace_dir is not None:
+        from ..utils.profiling import op_breakdown, overlap_stats
+
+        report["overlap_stats"] = overlap_stats(trace_dir)
+        report["op_breakdown"] = [
+            {"op": k, "total_us": us, "count": c}
+            for k, us, c in op_breakdown(trace_dir)]
+    return report

@@ -207,6 +207,54 @@ def z_g_vec(dz, A, *, layout=None):
     return _x_g_vec(dz, A, 2, layout)
 
 
+def _cli(argv=None) -> int:
+    """``python -m implicitglobalgrid_tpu.tools`` — operator CLI.
+
+    Subcommands:
+
+    - ``report <run.jsonl> [--trace DIR] [--run-id ID] [--indent N]
+      [--no-metrics]`` — print the unified `telemetry.run_report` for a
+      flight-recorder stream (post-hoc: works on a file from a run that
+      died hours ago; ``--trace`` merges a profiler capture's
+      overlap/op-breakdown numbers).
+    - ``prom`` — print the current process's Prometheus metrics snapshot
+      (mostly useful under ``python -i`` / notebook sessions; scrapers of
+      a LIVE run export `prometheus_snapshot()` themselves).
+    """
+    import argparse
+    import json
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m implicitglobalgrid_tpu.tools",
+        description="implicitglobalgrid_tpu operator tools")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser("report", help="unified run report from a "
+                                       "flight-recorder JSONL stream")
+    rp.add_argument("jsonl", help="flight-recorder .jsonl file")
+    rp.add_argument("--trace", default=None,
+                    help="profiler capture dir to merge "
+                         "(overlap_stats/op_breakdown)")
+    rp.add_argument("--run-id", default=None,
+                    help="run id when the file holds several runs "
+                         "(default: the last run)")
+    rp.add_argument("--indent", type=int, default=2)
+    rp.add_argument("--no-metrics", action="store_true",
+                    help="omit the (empty, post-hoc) registry snapshot")
+    sub.add_parser("prom", help="Prometheus text-format metrics snapshot")
+    args = ap.parse_args(argv)
+
+    from .telemetry import prometheus_snapshot, run_report
+
+    if args.cmd == "prom":
+        sys.stdout.write(prometheus_snapshot())
+        return 0
+    rep = run_report(args.jsonl, run_id=args.run_id, trace_dir=args.trace,
+                     include_metrics=not args.no_metrics)
+    print(json.dumps(rep, indent=args.indent, default=str))
+    return 0
+
+
 def coords_g(dx, dy, dz, A):
     """Broadcastable (x, y, z) global-coordinate arrays for stacked array ``A``
     — the TPU-native initial-condition idiom::
@@ -226,3 +274,9 @@ def coords_g(dx, dy, dz, A):
         sh[dim] = v.shape[0]
         outs.append(v.reshape(sh))
     return tuple(outs)
+
+
+if __name__ == "__main__":  # python -m implicitglobalgrid_tpu.tools ...
+    import sys
+
+    sys.exit(_cli(sys.argv[1:]))

@@ -70,6 +70,7 @@ with the same decomposition. In multi-host runs save/restore are collective
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
@@ -174,6 +175,7 @@ def save_checkpoint(path, state: dict, *, step: int | None = None,
     from ..ops.gather import gather
 
     check_initialized()
+    t0 = time.monotonic()
     if not isinstance(state, dict) or not state:
         raise InvalidArgumentError(
             "save_checkpoint expects a non-empty dict of name -> array.")
@@ -202,6 +204,9 @@ def save_checkpoint(path, state: dict, *, step: int | None = None,
     from .timing import barrier
 
     barrier()
+    from ..telemetry import observe_checkpoint
+
+    observe_checkpoint("save", time.monotonic() - t0, path=path, step=step)
 
 
 def load_checkpoint(path):
@@ -270,6 +275,7 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
     from ..ops.alloc import device_put_g
 
     check_initialized()
+    t0 = time.monotonic()
     if not isinstance(state, dict) or not state:
         raise InvalidArgumentError(
             "save_checkpoint_sharded expects a non-empty dict of "
@@ -355,6 +361,10 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
     # Final barrier: no process returns (and possibly starts the NEXT
     # save, or reports the checkpoint usable) before the commit rename.
     barrier()
+    from ..telemetry import observe_checkpoint
+
+    observe_checkpoint("save_sharded", time.monotonic() - t0, path=dirpath,
+                       step=step)
 
 
 def _load_meta(dirpath) -> dict:
@@ -489,6 +499,7 @@ def restore_checkpoint_sharded(dirpath, *, strict: bool = True,
     from ..ops.alloc import sharding_of
 
     check_initialized()
+    t0 = time.monotonic()
     gg = global_grid()
     meta, files, checksums, verified = (
         _preloaded if _preloaded is not None
@@ -530,6 +541,10 @@ def restore_checkpoint_sharded(dirpath, *, strict: bool = True,
             arrays.extend(jax.device_put(block, dev) for dev in devs)
         out[name] = jax.make_array_from_single_device_arrays(
             shape, sharding, arrays)
+    from ..telemetry import observe_checkpoint
+
+    observe_checkpoint("restore_sharded", time.monotonic() - t0,
+                       path=dirpath, step=step)
     return out, step
 
 
@@ -641,6 +656,7 @@ def restore_checkpoint_elastic(dirpath):
     from ..ops.alloc import sharding_of
 
     check_initialized()
+    t0 = time.monotonic()
     gg = global_grid()
     meta, files, checksums, verified = _sharded_meta_and_files(dirpath)
     names = [str(n) for n in meta["names"]]
@@ -739,6 +755,12 @@ def restore_checkpoint_elastic(dirpath):
             arrays.extend(jax.device_put(block, dev) for dev in devs)
         out[name] = jax.make_array_from_single_device_arrays(
             shape_n, sharding, arrays)
+    from ..telemetry import observe_checkpoint
+
+    observe_checkpoint("restore_elastic", time.monotonic() - t0,
+                       path=dirpath, step=step,
+                       saved_dims=[int(d) for d in dims_o],
+                       live_dims=[int(d) for d in np.asarray(gg.dims)])
     return out, step
 
 
@@ -753,8 +775,13 @@ def restore_checkpoint(path, *, strict: bool = True):
     from ..ops.alloc import device_put_g
 
     check_initialized()
+    t0 = time.monotonic()
     gg = global_grid()
     state, meta = load_checkpoint(path)
     _validate_topology(meta, gg, strict)
     out = {k: device_put_g(v) for k, v in state.items()}
+    from ..telemetry import observe_checkpoint
+
+    observe_checkpoint("restore", time.monotonic() - t0, path=path,
+                       step=meta["step"])
     return out, meta["step"]

@@ -36,33 +36,52 @@ __all__ = ["trace", "annotate", "overlap_stats", "op_breakdown",
 
 
 # ---------------------------------------------------------------------------
-# Resilient-runtime health counters (`runtime/driver.py` records, operators
-# export) — the monitoring story the reference lacks entirely (SURVEY §5.4:
-# tic/toc is its whole observability surface). Process-local, monotone
-# within a run; the driver records one event per chunk/guard/recovery
-# action, so a metrics exporter can scrape `health_counters()` alongside
-# `overlap_stats` without touching driver internals.
+# Resilient-runtime health counters — BACK-COMPAT SHIM over the telemetry
+# metrics registry (`telemetry/registry.py`). The PR-2 ad-hoc dict grew into
+# the ``igg_health_events_total{kind=...}`` counter family; these three
+# functions keep the original API working (tests, operator scrapers) and
+# are the documented deprecation path: new code should use
+# ``igg.metrics_registry()`` / ``igg.prometheus_snapshot()`` directly.
 # ---------------------------------------------------------------------------
 
-_health_counters: dict = {}
+HEALTH_METRIC = "igg_health_events_total"
+_HEALTH_HELP = ("Resilient-runtime events by kind (chunks, guard_trips, "
+                "rollbacks, checkpoints_saved, restores, restore_fallbacks, "
+                "elastic_restarts, escalations).")
 
 
 def record_health_event(kind: str, n: int = 1) -> None:
     """Bump the ``kind`` counter by ``n`` (used by `runtime.run_resilient`:
     kinds include ``chunks``, ``guard_trips``, ``rollbacks``,
     ``checkpoints_saved``, ``restores``, ``restore_fallbacks``,
-    ``elastic_restarts``, ``escalations``)."""
-    _health_counters[kind] = _health_counters.get(kind, 0) + int(n)
+    ``elastic_restarts``, ``escalations``). Now a shim over the telemetry
+    registry's `HEALTH_METRIC` counter family."""
+    from ..telemetry import metrics_registry
+
+    metrics_registry().counter(HEALTH_METRIC, _HEALTH_HELP,
+                               ("kind",)).inc(int(n), kind=str(kind))
 
 
 def health_counters() -> dict:
-    """Snapshot of the resilient-runtime counters (a copy — safe to mutate)."""
-    return dict(_health_counters)
+    """Snapshot of the resilient-runtime counters (a copy — safe to
+    mutate). DEPRECATED alias for reading the registry's
+    ``igg_health_events_total`` family; prefer ``igg.metrics_registry()``
+    or ``igg.prometheus_snapshot()``."""
+    from ..telemetry import metrics_registry
+
+    fam = metrics_registry().get(HEALTH_METRIC)
+    if fam is None:
+        return {}
+    return {labels["kind"]: int(v) for labels, v in fam.samples()}
 
 
 def reset_health_counters() -> None:
-    """Zero all counters (test isolation; scrape-and-reset exporters)."""
-    _health_counters.clear()
+    """Zero the health counters only (test isolation; scrape-and-reset
+    exporters). Other telemetry metric families are untouched — use
+    ``igg.reset_metrics()`` to zero everything."""
+    from ..telemetry import metrics_registry
+
+    metrics_registry().reset(HEALTH_METRIC)
 
 
 @contextlib.contextmanager
@@ -260,6 +279,25 @@ _HOST_COMM_RE = re.compile(
 )
 
 
+def _host_event_class(ev):
+    """Classify one host thread-pool event: ``"comm"`` (collective op
+    kinds + the CPU backend's rendezvous machinery), ``"thunk"`` (HLO
+    thunk spans: lowercase-named, not C++ infrastructure, not the
+    ``while`` container), or ``None`` (completion markers, zero-duration,
+    infrastructure). The ONE predicate shared by `_host_overlap_stats`
+    and `_host_op_agg` so the two fallbacks can never desynchronize."""
+    if ev.duration_ps <= 0 or ev.name.startswith("end: "):
+        # completion markers are neither comm nor compute — excluded
+        # BEFORE the comm match, or 'end: ppermute.3' would count
+        return None
+    kind = _op_kind(ev.name)
+    if _COMM_RE.search(kind) or _HOST_COMM_RE.search(ev.name):
+        return "comm"
+    if ev.name[:1].islower() and "::" not in ev.name and kind != "while":
+        return "thunk"
+    return None
+
+
 def _host_overlap_stats(log_dir: str):
     """Comm/compute overlap from the HOST thread-pool lines — the fallback
     when the capture has no ``/device:`` planes (the XLA:CPU backend, incl.
@@ -296,19 +334,11 @@ def _host_overlap_stats(log_dir: str):
             if not line.name.startswith("tf_"):
                 continue
             for ev in line.events:
-                if ev.duration_ps <= 0:
-                    continue
-                if ev.name.startswith("end: "):
-                    continue  # completion markers are neither comm nor
-                    # compute — excluded BEFORE the comm match, or
-                    # 'end: ppermute.3' would count as a comm span
-                iv = (ev.start_ps, ev.end_ps)
-                kind = _op_kind(ev.name)
-                if _COMM_RE.search(kind) or _HOST_COMM_RE.search(ev.name):
-                    comm.append(iv)
-                elif (ev.name[:1].islower() and "::" not in ev.name
-                      and kind != "while"):
-                    compute.append(iv)
+                cls = _host_event_class(ev)
+                if cls == "comm":
+                    comm.append((ev.start_ps, ev.end_ps))
+                elif cls == "thunk":
+                    compute.append((ev.start_ps, ev.end_ps))
     if not comm and not compute:
         return {}
     return {"CPU:threadpool": _stats_from(comm, compute)}
@@ -318,7 +348,13 @@ def op_breakdown(log_dir: str, top: int = 12):
     """Aggregate device time by op kind over the NEWEST capture under
     ``log_dir``: ``[(kind, total_us, count), …]`` sorted by time. Fusions
     appear as 'fusion', the exchange's wire ops as 'collective-permute*',
-    Pallas kernels as 'custom-call' (Mosaic kernels are custom calls)."""
+    Pallas kernels as 'custom-call' (Mosaic kernels are custom calls).
+
+    Captures with no ``/device:`` op events (the XLA:CPU backend, incl.
+    the virtual multi-device mesh) fall back to the host thread-pool
+    lines — the same fallback `overlap_stats` has — aggregating the HLO
+    thunk spans (and the rendezvous comm machinery) by op kind; an empty
+    list means the capture had neither."""
     agg: dict = {}
     for plane in _device_planes(log_dir):
         for line in plane.lines:
@@ -328,6 +364,30 @@ def op_breakdown(log_dir: str, top: int = 12):
                 kind = _op_kind(ev.name)
                 t, c = agg.get(kind, (0, 0))
                 agg[kind] = (t + ev.duration_ps, c + 1)
+    if not agg:
+        agg = _host_op_agg(log_dir)
     rows = sorted(((k, t / 1e6, c) for k, (t, c) in agg.items()),
                   key=lambda r: -r[1])
     return rows[:top]
+
+
+def _host_op_agg(log_dir: str) -> dict:
+    """`op_breakdown`'s host thread-pool fallback: per-kind (time, count)
+    from the runtime pool (``tf_*``) lines of ``/host:CPU`` planes, using
+    the SAME event classification as `_host_overlap_stats`
+    (`_host_event_class`): HLO thunk spans plus the collective/rendezvous
+    comm spans; completion markers and C++ infrastructure excluded."""
+    agg: dict = {}
+    for plane in _all_planes(log_dir):
+        if not plane.name.startswith("/host:CPU"):
+            continue
+        for line in plane.lines:
+            if not line.name.startswith("tf_"):
+                continue
+            for ev in line.events:
+                if _host_event_class(ev) is None:
+                    continue
+                kind = _op_kind(ev.name)
+                t, c = agg.get(kind, (0, 0))
+                agg[kind] = (t + ev.duration_ps, c + 1)
+    return agg

@@ -203,8 +203,19 @@ def toc(sync_on=None) -> float:
     """Elapsed seconds since `tic` once all devices have reached this point
     (reference `tools.jl:235`). Pass the arrays produced by the timed region
     as ``sync_on`` to guarantee their computations are included (data-
-    dependent drain; framework runners like ``run_chunked`` already sync)."""
+    dependent drain; framework runners like ``run_chunked`` already sync).
+
+    Raises `InvalidArgumentError` when no `tic` started the chronometer
+    (instead of the bare ``NoneType`` TypeError the subtraction would
+    throw)."""
     check_initialized()
+    if _t0 is None:
+        from .exceptions import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            "toc() called with no running chronometer: call tic() first "
+            "(init_global_grid pre-compiles the pair, but "
+            "finalize_global_grid resets it).")
     _sync_then_barrier(sync_on)
     return time.time() - _t0
 

@@ -471,6 +471,53 @@ def test_guarded_runner_adds_exactly_one_small_allreduce():
         assert lines and all("f32[4]" in ln for ln in lines), lines
 
 
+def test_telemetry_leaves_chunk_program_untouched(tmp_path):
+    """THE observability wire claim (ISSUE 3): telemetry is host-side
+    only — building the guarded chunk runner with an ACTIVE flight
+    recorder (and live metrics registry) yields a program with identical
+    collective counts and an identical fetch surface (same output arity,
+    same parameter count) as with telemetry off. Zero extra collectives,
+    zero extra D2H fetches per chunk."""
+    import re as _re
+
+    from implicitglobalgrid_tpu.models import (
+        diffusion_step_local, init_diffusion3d,
+    )
+    from implicitglobalgrid_tpu.runtime.health import make_guarded_runner
+    from implicitglobalgrid_tpu.telemetry import (
+        start_flight_recorder, stop_flight_recorder,
+    )
+
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    T, Cp, p = init_diffusion3d(dtype=np.float32)
+
+    def step(s):
+        return (diffusion_step_local(s[0], s[1], p, "xla"), s[1])
+
+    off = make_guarded_runner(step, (3, 3), nt_chunk=4, key="hlo_tel_off")
+    hlo_off = off.lower(T, Cp).compile().as_text()
+    start_flight_recorder(str(tmp_path / "fr.jsonl"))
+    try:
+        on = make_guarded_runner(step, (3, 3), nt_chunk=4, key="hlo_tel_on")
+        hlo_on = on.lower(T, Cp).compile().as_text()
+        out_on = on(T, Cp)
+    finally:
+        stop_flight_recorder()
+    out_off = off(T, Cp)
+
+    assert (_count_collective_permutes(hlo_on)
+            == _count_collective_permutes(hlo_off))
+    assert _count_all_reduces(hlo_on) == _count_all_reduces(hlo_off) == 1
+    assert "all-gather" not in hlo_on and "all-to-all" not in hlo_on
+    # identical fetch surface: same program inputs and outputs — the
+    # driver's one tiny stats fetch stays the ONLY per-chunk D2H
+    for pat in (r"= \S+ parameter\(", r"infeed", r"outfeed"):
+        assert (len(_re.findall(pat, hlo_on))
+                == len(_re.findall(pat, hlo_off)))
+    assert len(out_on) == len(out_off) == 3  # T, Cp, stats vector
+
+
 def test_permute_count_with_halowidth_2():
     """halowidth>1 exchanges still cost one pair per axis (slab width is
     static, not a per-row loop)."""

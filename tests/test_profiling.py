@@ -169,6 +169,45 @@ def test_device_planes_preempt_host_fallback(tmp_path):
     assert "TPU:0" in stats and "CPU:threadpool" not in stats
 
 
+def test_op_breakdown_host_fallback(tmp_path):
+    """A capture with NO /device: op events (the XLA:CPU backend) must
+    fall back to the host thread-pool lines instead of returning [] —
+    thunk spans and comm machinery aggregate by kind, infrastructure and
+    completion markers stay excluded (satellite of ISSUE 3)."""
+    from implicitglobalgrid_tpu.utils.profiling import op_breakdown
+
+    metas = [(1, _meta(1, "wrapped_add")),
+             (2, _meta(2, "ppermute.42")),
+             (3, _meta(3, "ThunkExecutor::Execute")),
+             (4, _meta(4, "end: ppermute.42")),
+             (5, _meta(5, "fusion.3")),
+             (6, _meta(6, "Rendezvous")),
+             (7, _meta(7, "while.3"))]
+    lines = [
+        _line("tf_XLAEigen/1", 0, [_event(1, 0, 3_000_000),
+                                   _event(1, 4_000_000, 1_000_000),
+                                   _event(2, 2_000_000, 6_000_000),
+                                   _event(3, 0, 10_000_000),
+                                   _event(4, 9_500_000, 1_000_000)]),
+        _line("tf_XLAEigen/2", 0, [_event(5, 0, 2_000_000),
+                                   _event(6, 6_000_000, 3_000_000),
+                                   _event(7, 0, 9_000_000)]),
+    ]
+    _write_run(tmp_path, [_plane("/host:CPU", lines, metas)])
+
+    rows = op_breakdown(str(tmp_path))
+    by_kind = {k: (us, c) for k, us, c in rows}
+    assert by_kind["wrapped_add"] == (4.0, 2)
+    assert by_kind["ppermute"] == (6.0, 1)
+    assert by_kind["fusion"] == (2.0, 1)
+    assert by_kind["Rendezvous"] == (3.0, 1)
+    # infrastructure, completion markers, and the while container excluded
+    assert not any("::" in k or k.startswith("end") or k == "while"
+                   for k in by_kind)
+    # first row is the biggest time sink
+    assert rows[0][0] == "ppermute"
+
+
 def test_op_breakdown_synthetic(tmp_path):
     from implicitglobalgrid_tpu.utils.profiling import op_breakdown
 

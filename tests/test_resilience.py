@@ -124,6 +124,30 @@ def test_health_counters_record_and_reset(tmp_path):
     assert igg.health_counters() == {}
 
 
+def test_terminal_checkpoint_saved_off_cadence(tmp_path):
+    """nt % checkpoint_every != 0 must still save the TERMINAL state, so a
+    follow-on run can resume from step nt instead of replaying from the
+    last cadence save (satellite of ISSUE 3)."""
+    from implicitglobalgrid_tpu.runtime.driver import _CheckpointSlots
+
+    _init()
+    step, state = _diffusion_step()
+    out, reports = igg.run_resilient(
+        step, state, 12, nt_chunk=5, key="resil_final",
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=5)
+    st, at, fellback = _CheckpointSlots(str(tmp_path / "ck")).restore()
+    assert at == 12 and not fellback
+    assert np.array_equal(np.asarray(st["T"]), np.asarray(out["T"]))
+    # on-cadence end: exactly one save at the final step, not two
+    igg.reset_health_counters()
+    out, reports = igg.run_resilient(
+        step, dict(state), 10, nt_chunk=5, key="resil_final2",
+        checkpoint_dir=str(tmp_path / "ck2"), checkpoint_every=5)
+    assert igg.health_counters()["checkpoints_saved"] == 3  # init + 5 + 10
+    st, at, _ = _CheckpointSlots(str(tmp_path / "ck2")).restore()
+    assert at == 10
+
+
 def test_guard_trip_without_checkpoint_is_fatal():
     _init()
     step, state = _diffusion_step()

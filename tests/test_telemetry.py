@@ -1,0 +1,506 @@
+"""Observability subsystem tests (ISSUE 3): metrics registry (incl.
+thread-safety under concurrent `on_report`-style writers), flight-recorder
+JSONL round-trip, Prometheus exposition parsing, static halo comm
+accounting, the unified run report reconstructing a fault-injected run
+from the JSONL alone, and the PR's satellite fixes."""
+
+import json
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu import telemetry
+from implicitglobalgrid_tpu.telemetry.registry import MetricsRegistry
+from implicitglobalgrid_tpu.utils.exceptions import InvalidArgumentError
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """No recorder or metric series leaks between tests (family
+    registrations survive by design — handles stay valid)."""
+    igg.stop_flight_recorder()
+    igg.reset_metrics()
+    yield
+    igg.stop_flight_recorder()
+    igg.reset_metrics()
+
+
+def _init(dimx=2, dimy=2, dimz=1):
+    igg.init_global_grid(6, 6, 6, dimx=dimx, dimy=dimy, dimz=dimz,
+                         quiet=True)
+
+
+def _diffusion_step():
+    from implicitglobalgrid_tpu.models import (
+        diffusion_step_local, init_diffusion3d,
+    )
+
+    T, Cp, p = init_diffusion3d(dtype=np.float64)
+
+    def step(s):
+        return {"T": diffusion_step_local(s["T"], s["Cp"], p, "xla"),
+                "Cp": s["Cp"]}
+
+    return step, {"T": T, "Cp": Cp}
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "a counter", ("kind",))
+    c.inc(1, kind="a")
+    c.inc(2.5, kind="a")
+    c.inc(1, kind="b")
+    assert c.value(kind="a") == 3.5 and c.value(kind="b") == 1
+    g = reg.gauge("g", "a gauge")
+    g.set(7)
+    g.add(-2)
+    assert g.value() == 5
+    h = reg.histogram("h_seconds", "a histogram", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 100.0):
+        h.observe(v)
+    ((labels, st),) = h.samples()
+    assert labels == {} and st["count"] == 4
+    assert st["counts"] == [1, 2, 0, 1]  # <=0.1, <=1, <=10, +Inf
+    assert abs(st["sum"] - 101.05) < 1e-9
+
+
+def test_registry_registration_conflicts_and_validation():
+    reg = MetricsRegistry()
+    c = reg.counter("x_total", "h", ("a",))
+    assert reg.counter("x_total", "h", ("a",)) is c  # idempotent
+    with pytest.raises(InvalidArgumentError, match="already registered"):
+        reg.gauge("x_total")
+    with pytest.raises(InvalidArgumentError, match="already registered"):
+        reg.counter("x_total", "h", ("b",))
+    with pytest.raises(InvalidArgumentError, match="Invalid metric name"):
+        reg.counter("bad name")
+    with pytest.raises(InvalidArgumentError, match="Invalid label name"):
+        reg.counter("ok_total", "h", ("bad-label",))
+    with pytest.raises(InvalidArgumentError, match="takes labels"):
+        c.inc(1, wrong="z")
+    with pytest.raises(InvalidArgumentError, match="cannot decrease"):
+        c.inc(-1, a="z")
+    with pytest.raises(InvalidArgumentError, match="strictly increasing"):
+        reg.histogram("h2", "h", buckets=(1.0, 1.0))
+
+
+def test_registry_thread_safety():
+    """The driver's `on_report` callbacks may record from user threads:
+    concurrent counter/histogram writes (plus a snapshotting reader) must
+    never lose an increment or crash."""
+    reg = MetricsRegistry()
+    c = reg.counter("threads_total", "t", ("worker",))
+    h = reg.histogram("threads_seconds", "t", buckets=(0.5, 1.0))
+    n_threads, n_iter = 8, 2000
+    errs = []
+
+    def writer(w):
+        try:
+            for i in range(n_iter):
+                c.inc(1, worker=str(w % 4))
+                h.observe((i % 3) * 0.4)
+        except Exception as e:  # pragma: no cover - failure path
+            errs.append(e)
+
+    def reader():
+        try:
+            for _ in range(200):
+                telemetry.prometheus_snapshot(reg)
+                reg.collect()
+        except Exception as e:  # pragma: no cover - failure path
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(n_threads)] + [threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert sum(v for _, v in c.samples()) == n_threads * n_iter
+    total = sum(st["count"] for _, st in h.samples())
+    assert total == n_threads * n_iter
+
+
+def test_health_counters_shim_over_registry():
+    """PR-2's `health_counters` API keeps working as a thin shim over the
+    `igg_health_events_total` family; resetting it leaves other metric
+    families untouched (the documented deprecation path)."""
+    igg.record_health_event("chunks")
+    igg.record_health_event("chunks", 2)
+    igg.record_health_event("rollbacks")
+    assert igg.health_counters() == {"chunks": 3, "rollbacks": 1}
+    fam = igg.metrics_registry().get("igg_health_events_total")
+    assert fam is not None and fam.value(kind="chunks") == 3
+    other = igg.metrics_registry().counter("unrelated_total", "x")
+    other.inc(5)
+    igg.reset_health_counters()
+    assert igg.health_counters() == {}
+    assert other.value() == 5
+    snap = telemetry.prometheus_snapshot()
+    assert "unrelated_total 5" in snap
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition format
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? "
+    r"([+-]?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|Inf|NaN))$")
+_LABEL_ITEM_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def test_prometheus_snapshot_parses_with_escaped_labels():
+    reg = MetricsRegistry()
+    nasty = 'he said "hi"\\there\nnewline'
+    reg.counter("esc_total", "counts\nwith newline help", ("who",)).inc(
+        4, who=nasty)
+    reg.gauge("level", "plain").set(2.5)
+    reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0)).observe(0.3)
+    text = telemetry.prometheus_snapshot(reg)
+    assert text.endswith("\n")
+    helps, types, samples = {}, {}, []
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            name, help_text = line[len("# HELP "):].split(" ", 1)
+            helps[name] = help_text
+            assert "\n" not in help_text
+            continue
+        if line.startswith("# TYPE "):
+            name, kind = line[len("# TYPE "):].split(" ")
+            assert kind in ("counter", "gauge", "histogram")
+            types[name] = kind
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        samples.append(m.groups())
+    assert types == {"esc_total": "counter", "level": "gauge",
+                     "lat_seconds": "histogram"}
+    # every sample belongs to a declared family (histogram suffixes too)
+    for name, labels, _ in samples:
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in types or base in types, name
+        for k, v in _LABEL_ITEM_RE.findall(labels or ""):
+            if k == "who":  # the escaped value round-trips
+                unescaped = (v.replace("\\\\", "\0").replace('\\"', '"')
+                             .replace("\\n", "\n").replace("\0", "\\"))
+                assert unescaped == nasty
+    # histogram semantics: cumulative buckets, +Inf == _count
+    hist = {n: float(v) for n, l, v in samples if n.startswith("lat_")}
+    by_le = [(l, float(v)) for n, l, v in samples
+             if n == "lat_seconds_bucket"]
+    cum = [v for _, v in by_le]
+    assert cum == sorted(cum) and cum[-1] == hist["lat_seconds_count"] == 1
+    assert abs(hist["lat_seconds_sum"] - 0.3) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_roundtrip(tmp_path):
+    """write -> read -> report: records carry monotonic timestamps, run id,
+    pid, process index, and a per-recorder sequence number."""
+    p = str(tmp_path / "run.jsonl")
+    rec = igg.start_flight_recorder(p, run_id="r1")
+    igg.record_event("alpha", x=1, arr=np.int64(7), frac=np.float32(0.33))
+    with igg.record_span("beta", label="timed"):
+        pass
+    rec.event("gamma")
+    path = igg.stop_flight_recorder()
+    assert path == p
+    evs = igg.read_flight_events(path)
+    kinds = [e["kind"] for e in evs]
+    assert kinds == ["recorder_open", "alpha", "beta", "gamma",
+                     "recorder_close"]
+    ts = [e["t"] for e in evs]
+    assert ts == sorted(ts)
+    assert [e["seq"] for e in evs] == list(range(len(evs)))
+    assert all(e["run"] == "r1" and e["pid"] == os.getpid()
+               and "proc" in e for e in evs)
+    assert evs[1]["x"] == 1 and evs[1]["arr"] == 7  # numpy serialized
+    assert abs(evs[1]["frac"] - 0.33) < 1e-6  # np floats NOT int-truncated
+    assert evs[2]["dur_s"] >= 0 and evs[2]["label"] == "timed"
+    assert evs[0]["wall"] > 0  # wall-clock anchor for the monotonic ts
+
+
+def test_record_event_is_noop_without_recorder(tmp_path):
+    assert igg.flight_recorder() is None
+    igg.record_event("nothing", x=1)  # must not raise or create files
+    with igg.record_span("nothing_timed"):
+        pass
+    assert list(tmp_path.iterdir()) == []
+    assert igg.stop_flight_recorder() is None
+
+
+def test_read_tolerates_torn_final_line_only(tmp_path):
+    p = tmp_path / "torn.jsonl"
+    p.write_text(json.dumps({"kind": "a", "run": "r"}) + "\n"
+                 + '{"kind": "b", "run')  # crash mid-write
+    evs = igg.read_flight_events(str(p))
+    assert [e["kind"] for e in evs] == ["a"]
+    p2 = tmp_path / "corrupt.jsonl"
+    p2.write_text('garbage\n' + json.dumps({"kind": "a"}) + "\n")
+    with pytest.raises(InvalidArgumentError, match="interior"):
+        igg.read_flight_events(str(p2))
+    with pytest.raises(InvalidArgumentError, match="not found"):
+        igg.read_flight_events(str(tmp_path / "missing.jsonl"))
+
+
+def test_failed_recorder_open_keeps_active_recorder(tmp_path):
+    """start_flight_recorder with an unopenable path must raise WITHOUT
+    killing the currently-active recorder."""
+    r = igg.start_flight_recorder(str(tmp_path / "ok.jsonl"), run_id="keep")
+    with pytest.raises(OSError):
+        igg.start_flight_recorder(str(tmp_path / "no" / "such" / "x.jsonl"))
+    assert igg.flight_recorder() is r
+    igg.record_event("still_alive")
+    path = igg.stop_flight_recorder()
+    assert any(e["kind"] == "still_alive"
+               for e in igg.read_flight_events(path))
+
+
+def test_recorder_thread_safety(tmp_path):
+    """Concurrent writers (driver thread + on_report user threads) produce
+    a valid JSONL stream with unique, gapless sequence numbers."""
+    igg.start_flight_recorder(str(tmp_path / "mt.jsonl"), run_id="mt")
+    n_threads, n_iter = 6, 300
+
+    def writer(w):
+        for i in range(n_iter):
+            igg.record_event("w", worker=w, i=i)
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    path = igg.stop_flight_recorder()
+    evs = igg.read_flight_events(path)
+    ws = [e for e in evs if e["kind"] == "w"]
+    assert len(ws) == n_threads * n_iter
+    seqs = [e["seq"] for e in evs]
+    assert sorted(seqs) == list(range(len(evs)))  # unique and gapless
+
+
+def test_recorder_into_directory_and_multi_run_filter(tmp_path):
+    igg.start_flight_recorder(str(tmp_path), run_id="runA")
+    igg.record_event("a")
+    path = igg.stop_flight_recorder()
+    assert os.path.basename(path) == "igg_run_runA.jsonl"
+    # second run appended into the SAME file still separates by run id
+    igg.start_flight_recorder(path, run_id="runB")
+    igg.record_event("b")
+    igg.stop_flight_recorder()
+    assert {e["run"] for e in igg.read_flight_events(path)} == \
+        {"runA", "runB"}
+    only_b = igg.read_flight_events(path, run_id="runB")
+    assert {e["run"] for e in only_b} == {"runB"}
+    rep = igg.run_report(path, include_metrics=False)
+    assert rep["run_id"] == "runB"  # default: the LAST run in the file
+    rep_a = igg.run_report(path, run_id="runA", include_metrics=False)
+    assert rep_a["run_id"] == "runA"
+    with pytest.raises(InvalidArgumentError, match="not present"):
+        igg.run_report(path, run_id="nope")
+
+
+# ---------------------------------------------------------------------------
+# Static halo comm accounting
+# ---------------------------------------------------------------------------
+
+def test_halo_comm_plan_bytes_and_collectives():
+    """2x2x2 fully periodic, hw=1, local 6^3 f64 blocks: per axis one
+    ppermute pair whose per-shard payload is a 36-cell slab; bytes sum the
+    payload over every link (2 shards send per direction)."""
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    T = igg.ones_g(dtype=np.float32)
+    plan = igg.halo_comm_plan(T)
+    slab = 6 * 6 * 1 * 4                      # cells x f32
+    per_axis = slab * (2 + 2)                 # 2 links per direction
+    assert plan["ppermutes"] == 6
+    assert plan["wire_bytes"] == 3 * per_axis
+    assert plan["local_copy_bytes"] == 0
+    assert set(plan["axes"]) == {"gx", "gy", "gz"}
+    assert all(r["by_dtype"] == {"float32": per_axis}
+               for r in plan["axes"].values())
+
+    # coalescing: 2 fields -> same ppermute count, bytes double; per-field
+    # path doubles the collectives instead (bytes invariant)
+    B = igg.ones_g(dtype=np.float32)
+    plan2 = igg.halo_comm_plan(T, B)
+    assert plan2["ppermutes"] == 6
+    assert plan2["wire_bytes"] == 2 * plan["wire_bytes"]
+    plan2pf = igg.halo_comm_plan(T, B, coalesce=False)
+    assert plan2pf["ppermutes"] == 12
+    assert plan2pf["wire_bytes"] == plan2["wire_bytes"]
+
+    # wire precision: f32 payloads ship as bf16 -> bytes halve
+    planw = igg.halo_comm_plan(T, B, wire_dtype="bfloat16")
+    assert planw["wire_bytes"] == plan2["wire_bytes"] // 2
+    assert all(set(r["by_dtype"]) == {"bfloat16"}
+               for r in planw["axes"].values())
+
+
+def test_halo_comm_plan_self_neighbor_and_nonperiodic():
+    # all-self periodic grid: no collectives, only local slab swaps
+    igg.init_global_grid(6, 6, 6, dimx=1, dimy=1, dimz=1,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    T = igg.ones_g(dtype=np.float32)
+    plan = igg.halo_comm_plan(T)
+    assert plan["ppermutes"] == 0 and plan["wire_bytes"] == 0
+    assert plan["local_copy_bytes"] == 3 * 2 * (6 * 6 * 4)
+    igg.finalize_global_grid()
+    # non-periodic 4x1x1: truncated chains -> 3 links per direction
+    igg.init_global_grid(6, 6, 6, dimx=4, dimy=1, dimz=1, quiet=True)
+    T = igg.ones_g(dtype=np.float32)
+    plan = igg.halo_comm_plan(T)
+    assert plan["ppermutes"] == 2
+    assert plan["wire_bytes"] == (6 * 6 * 4) * (3 + 3)
+
+
+def test_update_halo_charges_plan_to_registry():
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    T = igg.ones_g(dtype=np.float32)
+    plan = igg.halo_comm_plan(T)
+    reg = igg.metrics_registry()
+    base = reg.counter("igg_halo_exchanges_total").value()
+    T = igg.update_halo(T)
+    T = igg.update_halo(T)
+    assert reg.counter("igg_halo_exchanges_total").value() == base + 2
+    fam = reg.get("igg_halo_wire_bytes_total")
+    total = sum(v for _, v in fam.samples())
+    assert total == 2 * plan["wire_bytes"]
+    fam_p = reg.get("igg_halo_ppermutes_total")
+    assert sum(v for _, v in fam_p.samples()) == 2 * plan["ppermutes"]
+
+
+# ---------------------------------------------------------------------------
+# The unified run report (acceptance: reconstruct a fault-injected run
+# from the JSONL alone)
+# ---------------------------------------------------------------------------
+
+def test_run_report_reconstructs_fault_injected_run(tmp_path):
+    _init()
+    step, state = _diffusion_step()
+    igg.start_flight_recorder(str(tmp_path / "run.jsonl"), run_id="faulty")
+    out, reports = igg.run_resilient(
+        step, state, 20, nt_chunk=5, key="tel_fault",
+        checkpoint_dir=str(tmp_path / "ck"),
+        faults=[igg.NaNPoke(step=12, name="T", index=(0, 0, 0))])
+    path = igg.stop_flight_recorder()
+
+    # the report is built from the FILE alone (a fresh process could do it)
+    rep = igg.run_report(path, include_metrics=False)
+    assert rep["run_id"] == "faulty"
+    assert rep["steps"] == {"nt": 20, "completed": 20}
+    assert rep["chunks"]["count"] == len(reports)
+    assert rep["chunks"]["tripped"] == 1
+    assert rep["guards"] == {"trips": 1, "reasons": {"nonfinite:T": 1}}
+    assert rep["checkpoints"]["rollbacks"] == 1
+    assert rep["checkpoints"]["restores"] == 1
+    assert rep["checkpoints"]["saves"] >= 3
+    assert rep["checkpoints"]["save_s_total"] > 0
+    assert rep["chunks"]["exec_s_total"] > 0
+    assert rep["runner_cache"]["misses"] >= 1  # compiles attributed
+    assert rep["chunks"]["cold"] == rep["runner_cache"]["misses"]
+
+    # full event sequence, in order: the tripped chunk at the injection
+    # step, then restore -> rollback, then the recomputed chunks
+    kinds = [e["kind"] for e in rep["sequence"]]
+    assert kinds[0] == "run_begin" and kinds[-1] == "run_end"
+    i_fault = kinds.index("fault_injected")
+    i_trip = kinds.index("guard_trip")
+    i_restore = kinds.index("checkpoint_restore")
+    i_roll = kinds.index("rollback")
+    assert i_fault < i_trip < i_restore < i_roll < len(kinds) - 1
+    tripped = [e for e in rep["sequence"]
+               if e["kind"] == "chunk" and not e["ok"]]
+    assert len(tripped) == 1 and tripped[0]["step_begin"] == 12
+    roll = next(e for e in rep["sequence"] if e["kind"] == "rollback")
+    assert roll["to_step"] == 10 and roll["fallback"] is False
+    # chunk boundaries replay the driver's schedule exactly
+    spans = [(e["step_begin"], e["step_end"]) for e in rep["sequence"]
+             if e["kind"] == "chunk"]
+    assert spans[0] == (0, 5) and (10, 12) in spans and spans[-1] == (15, 20)
+
+
+def test_run_report_merges_trace_and_metrics(tmp_path):
+    """`run_report` is the single pane: flight log + registry snapshot +
+    profiler capture analysis in one structured record."""
+    _init()
+    step, state = _diffusion_step()
+    igg.start_flight_recorder(str(tmp_path / "run.jsonl"))
+    with igg.trace(str(tmp_path / "trace")):
+        out, _ = igg.run_resilient(step, state, 4, nt_chunk=2,
+                                   key="tel_trace")
+    path = igg.stop_flight_recorder()
+    rep = igg.run_report(path, trace_dir=str(tmp_path / "trace"))
+    assert "overlap_stats" in rep and "op_breakdown" in rep
+    assert isinstance(rep["op_breakdown"], list)
+    names = {fam["name"] for fam in rep["metrics"]}
+    assert "igg_health_events_total" in names
+    assert "igg_runner_cache_total" in names
+
+
+def test_report_cli_subprocess(tmp_path):
+    """The operator entry point: `python -m implicitglobalgrid_tpu.tools
+    report run.jsonl` prints the JSON report post-hoc."""
+    import subprocess
+    import sys
+
+    igg.start_flight_recorder(str(tmp_path / "run.jsonl"), run_id="cli")
+    igg.record_event("run_begin", nt=10)
+    igg.record_event("chunk", chunk=0, step_begin=0, step_end=10, ok=True,
+                     exec_s=0.1)
+    igg.record_event("run_end", completed=10, chunks=1)
+    path = igg.stop_flight_recorder()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "implicitglobalgrid_tpu.tools", "report",
+         path, "--no-metrics"],
+        capture_output=True, text=True, timeout=240,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rep = json.loads(proc.stdout)
+    assert rep["run_id"] == "cli"
+    assert rep["steps"] == {"nt": 10, "completed": 10}
+    assert rep["chunks"]["count"] == 1 and "metrics" not in rep
+
+
+# ---------------------------------------------------------------------------
+# Satellite: toc() before tic() raises the typed error
+# ---------------------------------------------------------------------------
+
+def test_toc_without_tic_raises_typed_error(monkeypatch):
+    from implicitglobalgrid_tpu.utils import timing
+
+    _init()
+    monkeypatch.setattr(timing, "_t0", None)
+    with pytest.raises(InvalidArgumentError, match="tic"):
+        igg.toc()
+    igg.tic()
+    assert igg.toc() >= 0.0
+
+
+def test_finalize_resets_chronometer():
+    from implicitglobalgrid_tpu.utils import timing
+
+    _init()
+    igg.tic()
+    igg.finalize_global_grid()
+    assert timing._t0 is None
