@@ -152,6 +152,16 @@ def main() -> None:
     for row in bench_telemetry.run_telemetry_overhead(dims3, cpu):
         results.append(bench_util.emit(row))
 
+    # --- io: async snapshot overhead + vs-gather speedup -------------------
+    # the snapshot pipeline's step-loop cost (submit = D2H + enqueue) as a
+    # fraction of run time, target < 2%, plus the speedup over the legacy
+    # gather-per-snapshot output path (ISSUE 4). Config owned by
+    # `bench_io.run_io_overhead` (shared with the standalone bench).
+    import bench_io
+
+    for row in bench_io.run_io_overhead(dims3, cpu):
+        results.append(bench_util.emit(row))
+
     # --- pseudo-transient Stokes 3-D (BASELINE config 5) -------------------
     nxs, nts = (24, 20) if cpu else (128, 300)
     igg.init_global_grid(nxs, nxs, nxs, dimx=dims3[0], dimy=dims3[1],

@@ -71,6 +71,11 @@ from .telemetry import (
     flight_recorder, record_event, record_span, read_flight_events,
     run_report,
 )
+from . import io
+from .io import (
+    SnapshotWriter, write_snapshot, open_snapshot, list_snapshots,
+    Probe, AxisSlice, Stats,
+)
 from .utils import exceptions
 
 __version__ = "0.1.0"
@@ -98,6 +103,9 @@ __all__ = [
     "prometheus_snapshot", "FlightRecorder", "start_flight_recorder",
     "stop_flight_recorder", "flight_recorder", "record_event",
     "record_span", "read_flight_events", "run_report", "halo_comm_plan",
+    # io (sharded snapshot & in-situ analysis pipeline)
+    "io", "SnapshotWriter", "write_snapshot", "open_snapshot",
+    "list_snapshots", "Probe", "AxisSlice", "Stats",
     "d_xa", "d_ya", "d_za", "d_xi", "d_yi", "d_zi", "inn",
     "stochastic_round_bf16",
     # state/introspection

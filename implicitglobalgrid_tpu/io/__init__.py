@@ -1,0 +1,46 @@
+"""Sharded snapshot & in-situ analysis pipeline — get data off the grid
+without ever building the grid.
+
+The framework's premise is that the global grid is never materialized, yet
+its classic output path (`ops/gather.py`) funnels the WHOLE global array
+through one host — and in multi-host runs stalls the step loop while every
+process materializes it. This subsystem (ISSUE 4 tentpole) replaces that
+funnel with three O(shard)-per-process pillars:
+
+- `snapshot` — **async sharded snapshots**: `SnapshotWriter` copies each
+  process's shard blocks device->host (the only step-loop-blocking cost)
+  and hands them to a bounded background writer queue with a backpressure
+  policy (``block`` | ``drop_oldest``); blocks land on disk in the PR-2
+  checkpoint container format (`utils/blockio.py`: block-coordinate keys,
+  sha256 sidecars, staged-directory atomic commit), so the jitted step
+  loop never waits on disk and an interrupted writer never leaves a
+  committed-but-corrupt snapshot.
+- `reducers` — **in-situ reduction**: point probes, axis slices, and
+  global min/max/mean/RMS over the IMPLICIT grid (overlap cells counted
+  once), fused into the supervised chunk program and reduced together
+  with the health guard in ONE tiny `psum` per chunk boundary — results
+  stream to the flight recorder, no gather ever.
+- `reader` — **lazy assembly**: `open_snapshot(dir)` + `read_global(
+  name, box=...)` assemble any sub-box of the implicit global grid on
+  the host in O(box) memory with `gather_interior`-identical semantics
+  (overlap stripped, periodic ghost shift and wrap handled) — the
+  analysis-side replacement for gather-to-root. Host-only: works on a
+  machine with no accelerator runtime, and reads PR-2 sharded
+  checkpoints too (same container format).
+
+Wired into `run_resilient(snapshot_dir=..., snapshot_every=...,
+reducers=[...])` (`runtime/driver.py`), the telemetry metric families
+(`igg_snapshot_bytes_total`, `igg_io_queue_depth`,
+`igg_snapshot_seconds` — `telemetry/hooks.py`), `igg.run_report`, and the
+``python -m implicitglobalgrid_tpu.tools snapshots|probe`` CLI.
+"""
+
+from .reader import Snapshot, list_snapshots, open_snapshot
+from .reducers import AxisSlice, Probe, Stats, build_reducer_plan
+from .snapshot import SnapshotWriter, write_snapshot
+
+__all__ = [
+    "SnapshotWriter", "write_snapshot",
+    "Snapshot", "open_snapshot", "list_snapshots",
+    "Probe", "AxisSlice", "Stats", "build_reducer_plan",
+]

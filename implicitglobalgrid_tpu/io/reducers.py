@@ -1,0 +1,364 @@
+"""In-situ reducers: probes, axis slices, global stats — one tiny psum.
+
+The analysis questions a long run actually asks per output interval —
+"what is the value at the sensor point", "give me the centerline", "is
+the max still bounded" — need O(1)..O(axis) numbers, yet the gather path
+answers them by materializing O(global). These reducers compute them
+INSIDE the supervised chunk program (`make_state_runner(post_chunk=...)`,
+the same fusion point as the health guard) over the IMPLICIT grid:
+every shard masks the cells it OWNS (`io/layout.py` — the
+`gather_interior` ownership arithmetic, overlap cells counted once,
+periodic ghosts excluded), contributes to a small f32 vector, and ONE
+`psum` over all mesh axes — shared with the health guard's stats, so an
+enabled reducer set adds ZERO extra collectives to the chunk program
+(`tests/test_hlo_audit.py`) — replicates the results to every process.
+The driver decodes the vector tail on the host and streams it to the
+flight recorder + metrics gauges. No gather, ever.
+
+Global min/max ride the same single psum via a slot trick: each shard
+writes its local masked min/max into ITS slot of a ``nprocs``-long
+segment (every other shard contributes zero there), and the host reduces
+over slots — sum-reduction hardware, min/max semantics, exactly.
+
+Reducer species (field names refer to the supervised state dict):
+
+- `Probe(field, index)` — one global cell's value per chunk boundary
+  (a point time-series; shard-local indexing, owner computed at trace
+  time).
+- `AxisSlice(field, axis, index)` — the 1-D line along ``axis`` through
+  global anchor ``index`` (``index[axis]`` is ignored).
+- `Stats(field, which=("min","max","mean","rms"))` — exact global scalar
+  stats over the implicit grid (float32 accumulation, like the health
+  guard).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from ..parallel.topology import AXIS_NAMES, global_grid
+from ..utils.exceptions import InvalidArgumentError
+from .layout import field_geometry, global_shape_of, owner_maps
+
+__all__ = ["Probe", "AxisSlice", "Stats", "ReducerPlan",
+           "build_reducer_plan", "make_reduced_post_chunk"]
+
+_STATS_KINDS = ("min", "max", "mean", "rms")
+
+
+@dataclass(frozen=True)
+class Probe:
+    """Value of one IMPLICIT-global cell of ``field`` (staggering
+    included: indices address `gather_interior(field)`'s coordinates)."""
+    field: str
+    index: tuple
+    name: str | None = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "index",
+                           tuple(int(i) for i in self.index))
+
+    @property
+    def label(self) -> str:
+        return self.name or f"probe:{self.field}@" + \
+            ",".join(str(i) for i in self.index)
+
+
+@dataclass(frozen=True)
+class AxisSlice:
+    """The 1-D line of ``field`` along ``axis`` through the global anchor
+    ``index`` (whose ``axis`` entry is ignored)."""
+    field: str
+    axis: int
+    index: tuple
+    name: str | None = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "index",
+                           tuple(int(i) for i in self.index))
+
+    @property
+    def label(self) -> str:
+        anchor = ",".join("_" if d == self.axis else str(i)
+                          for d, i in enumerate(self.index))
+        return self.name or f"slice:{self.field}[{self.axis}]@{anchor}"
+
+
+@dataclass(frozen=True)
+class Stats:
+    """Global scalar statistics of ``field`` over the implicit grid."""
+    field: str
+    which: tuple = dc_field(default=_STATS_KINDS)
+    name: str | None = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "which", tuple(self.which))
+        bad = [w for w in self.which if w not in _STATS_KINDS]
+        if bad or not self.which:
+            raise InvalidArgumentError(
+                f"Stats.which entries must be among {_STATS_KINDS}; "
+                f"got {tuple(self.which)}.")
+
+    @property
+    def label(self) -> str:
+        return self.name or f"stats:{self.field}"
+
+
+class ReducerPlan:
+    """The compiled-side layout of a reducer set: per-reducer segment
+    offsets into the chunk stats vector, the traced contribution builder,
+    and the host-side decoder. Built per grid epoch (`build_reducer_plan`)
+    because ownership geometry depends on the live decomposition —
+    `run_resilient` rebuilds it after an elastic restart."""
+
+    def __init__(self, entries, signature, nprocs: int):
+        self._entries = entries          # [(reducer, offset, length, geoms)]
+        self.signature = signature       # hashable: joins the runner key
+        self.nprocs = int(nprocs)        # min/max slot count at build time
+        self.length = sum(e[2] for e in entries)
+        self.labels = [e[0].label for e in entries]
+        dup = {l for l in self.labels if self.labels.count(l) > 1}
+        if dup:
+            raise InvalidArgumentError(
+                f"Duplicate reducer label(s) {sorted(dup)}: give the "
+                "colliding reducers distinct name=...")
+
+    # -- traced side -------------------------------------------------------
+
+    def local_parts(self, state_names, state):
+        """The PRE-psum contribution vector of this shard (inside
+        shard_map; ``state`` is the tuple of LOCAL blocks in
+        ``state_names`` order). float32, length `self.length`."""
+        import jax.numpy as jnp
+
+        by_name = dict(zip(state_names, state))
+        parts = []
+        for red, _off, _ln, geoms in self._entries:
+            x = by_name[red.field].astype(jnp.float32)
+            if isinstance(red, Probe):
+                parts.append(_probe_part(x, red, geoms))
+            elif isinstance(red, AxisSlice):
+                parts.append(_slice_part(x, red, geoms))
+            else:
+                parts.append(_stats_part(x, geoms))
+        return jnp.concatenate(parts)
+
+    # -- host side ---------------------------------------------------------
+
+    def decode(self, tail) -> dict:
+        """label -> value(s), from the psum'ed vector's reducer tail."""
+        tail = np.asarray(tail)
+        if tail.shape != (self.length,):
+            raise InvalidArgumentError(
+                f"Reducer tail has shape {tail.shape}; the plan expects "
+                f"({self.length},).")
+        out = {}
+        P = self.nprocs
+        for red, off, ln, geoms in self._entries:
+            seg = tail[off:off + ln]
+            if isinstance(red, Probe):
+                out[red.label] = float(seg[0])
+            elif isinstance(red, AxisSlice):
+                out[red.label] = np.array(seg)
+            else:
+                count = float(np.prod(global_shape_of(geoms)))
+                vals = {"min": float(np.min(seg[2:2 + P])),
+                        "max": float(np.max(seg[2 + P:2 + 2 * P])),
+                        "mean": float(seg[0]) / count,
+                        "rms": math.sqrt(max(float(seg[1]), 0.0) / count)}
+                out[red.label] = {w: vals[w] for w in red.which}
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Traced contribution builders (inside shard_map, pre-psum)
+# ---------------------------------------------------------------------------
+
+def _axis_idx(d):
+    from jax import lax
+
+    return lax.axis_index(AXIS_NAMES[d])
+
+
+def _replica_guard(rank: int):
+    """Fields of rank < 3 are replicated over the unused mesh axes: only
+    the axis-0 copy contributes, or the psum would multiply sums and
+    probes by the replica count."""
+    import jax.numpy as jnp
+
+    g = jnp.float32(1.0)
+    for d in range(rank, 3):
+        g = g * (_axis_idx(d) == 0).astype(jnp.float32)
+    return g
+
+
+def _is_owner(geoms, index, dims_sel):
+    """1.0 iff THIS shard owns the anchor cells of ``index`` along every
+    dim in ``dims_sel`` (owners are static host ints; the comparison
+    against `lax.axis_index` is the traced part)."""
+    import jax.numpy as jnp
+
+    m = jnp.float32(1.0)
+    locals_ = {}
+    for d in dims_sel:
+        c, i = owner_maps(geoms[d], np.asarray([index[d]]))
+        m = m * (_axis_idx(d) == int(c[0])).astype(jnp.float32) \
+            if d < 3 else m
+        locals_[d] = int(i[0])
+    return m, locals_
+
+
+def _own_mask_1d(geom, d):
+    """Traced ownership mask over the ``n`` local cells of dim ``d``."""
+    import jax.numpy as jnp
+
+    i = jnp.arange(geom.n)
+    if geom.per:
+        return (i >= 1) & (i <= geom.s)
+    last = _axis_idx(d) == geom.dd - 1 if d < 3 else True
+    return i < jnp.where(last, geom.n, geom.s)
+
+
+def _probe_part(x, red: Probe, geoms):
+    import jax.numpy as jnp
+
+    rank = x.ndim
+    mine, locals_ = _is_owner(geoms, red.index, range(rank))
+    val = x[tuple(locals_[d] for d in range(rank))]
+    return jnp.reshape(val * mine * _replica_guard(rank), (1,))
+
+
+def _slice_part(x, red: AxisSlice, geoms):
+    import jax.numpy as jnp
+
+    rank = x.ndim
+    a = red.axis
+    geom = geoms[a]
+    mine, locals_ = _is_owner(geoms, red.index,
+                              [d for d in range(rank) if d != a])
+    idx = tuple(slice(None) if d == a else locals_[d] for d in range(rank))
+    line = x[idx]                       # (n_a,) local cells along the axis
+    own = _own_mask_1d(geom, a).astype(jnp.float32)
+    c = _axis_idx(a) if a < 3 else 0
+    i = jnp.arange(geom.n)
+    if geom.per:
+        g = (c * geom.s + i - 1) % geom.size
+    else:
+        g = c * geom.s + i
+    contrib = line * own * mine * _replica_guard(rank)
+    return jnp.zeros((geom.size,), jnp.float32).at[g].add(contrib)
+
+
+def _stats_part(x, geoms):
+    import jax.numpy as jnp
+
+    rank = x.ndim
+    mask = None
+    for d in range(rank):
+        md = _own_mask_1d(geoms[d], d)
+        md = md.reshape([-1 if dd == d else 1 for dd in range(rank)])
+        mask = md if mask is None else mask & md
+    gg = global_grid()
+    guard = _replica_guard(rank)
+    ssum = jnp.sum(jnp.where(mask, x, 0.0)) * guard
+    ssq = jnp.sum(jnp.where(mask, x * x, 0.0)) * guard
+    mn = jnp.min(jnp.where(mask, x, jnp.inf))
+    mx = jnp.max(jnp.where(mask, x, -jnp.inf))
+    # slot trick: shard r's min/max land in slot r alone, the host takes
+    # min/max over slots — order statistics through a sum-collective
+    dims = [int(d) for d in gg.dims]
+    r = (_axis_idx(0) * dims[1] + _axis_idx(1)) * dims[2] + _axis_idx(2)
+    P = dims[0] * dims[1] * dims[2]
+    slots_mn = jnp.zeros((P,), jnp.float32).at[r].set(mn)
+    slots_mx = jnp.zeros((P,), jnp.float32).at[r].set(mx)
+    return jnp.concatenate([jnp.stack([ssum, ssq]), slots_mn, slots_mx])
+
+
+# ---------------------------------------------------------------------------
+# Plan building and the fused post-chunk hook
+# ---------------------------------------------------------------------------
+
+def build_reducer_plan(reducers, names, state) -> ReducerPlan:
+    """Validate ``reducers`` against the supervised ``state`` (dict of
+    name -> stacked array) on the LIVE grid and lay out their segments.
+    Host-side and cheap; the plan's `signature` must join the runner
+    cache key (geometry changes with the decomposition)."""
+    gg = global_grid()
+    entries = []
+    off = 0
+    P = int(np.prod(np.asarray(gg.dims)))
+    for red in reducers:
+        if not isinstance(red, (Probe, AxisSlice, Stats)):
+            raise InvalidArgumentError(
+                f"Unknown reducer type {type(red).__name__}; use Probe, "
+                "AxisSlice or Stats.")
+        if red.field not in names:
+            raise InvalidArgumentError(
+                f"Reducer {red.label!r} names unknown field "
+                f"{red.field!r} (state has {list(names)}).")
+        shape = tuple(int(s) for s in state[red.field].shape)
+        loc = [shape[d] // int(gg.dims[d]) if d < 3 else shape[d]
+               for d in range(len(shape))]
+        geoms = field_geometry(gg.dims, gg.nxyz, gg.overlaps, gg.periods,
+                               loc)
+        gshape = global_shape_of(geoms)
+        if isinstance(red, (Probe, AxisSlice)):
+            if len(red.index) != len(gshape):
+                raise InvalidArgumentError(
+                    f"Reducer {red.label!r} index {red.index} has "
+                    f"{len(red.index)} entries; field {red.field!r} is "
+                    f"{len(gshape)}-D (global shape {gshape}).")
+            for d, i in enumerate(red.index):
+                free = isinstance(red, AxisSlice) and d == red.axis
+                if not free and not 0 <= i < gshape[d]:
+                    raise InvalidArgumentError(
+                        f"Reducer {red.label!r} index {red.index} is "
+                        f"outside the implicit global shape {gshape}.")
+        if isinstance(red, AxisSlice):
+            if not 0 <= red.axis < len(gshape):
+                raise InvalidArgumentError(
+                    f"AxisSlice axis {red.axis} is outside field "
+                    f"{red.field!r}'s rank {len(gshape)}.")
+            ln = geoms[red.axis].size
+        elif isinstance(red, Probe):
+            ln = 1
+        else:
+            ln = 2 + 2 * P
+        entries.append((red, off, ln, geoms))
+        off += ln
+    # the signature must pin the GEOMETRY too, not just the specs: the
+    # hook closure bakes owner coords/strides in as static ints, and the
+    # runner cache would otherwise serve a stale closure for a same-named
+    # field whose staggering (local shape) changed within one grid epoch
+    sig = tuple(
+        (type(r).__name__, r.field,
+         getattr(r, "axis", None), getattr(r, "index", None),
+         getattr(r, "which", None), r.label, tuple(g))
+        for r, _o, _l, g in entries)
+    return ReducerPlan(entries, sig, P)
+
+
+def make_reduced_post_chunk(names, plan: ReducerPlan):
+    """The fused guard+reducer hook for `make_state_runner(post_chunk=)`:
+    health parts (`runtime/health.health_parts_local`) and reducer parts
+    concatenate into ONE vector reduced by ONE psum over all mesh axes —
+    the compiled chunk still carries exactly one tiny all-reduce
+    (`tests/test_hlo_audit.py`). The driver slices the fetched vector:
+    ``[:2*nfields]`` health, ``[2*nfields:]`` reducers."""
+    from jax import lax
+
+    from ..runtime.health import health_parts_local
+
+    names = tuple(names)
+
+    def post_chunk(state):
+        import jax.numpy as jnp
+
+        vec = jnp.concatenate([health_parts_local(state),
+                               plan.local_parts(names, state)])
+        return lax.psum(vec, AXIS_NAMES)
+
+    return post_chunk

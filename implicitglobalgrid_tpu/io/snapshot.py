@@ -1,0 +1,367 @@
+"""Async sharded snapshots: the step loop never waits on disk.
+
+`SnapshotWriter.submit` does the ONLY work that blocks the caller — a
+device->host copy of this process's addressable shard blocks (O(shard),
+the same per-process volume `save_checkpoint_sharded` writes) — then
+hands the host buffers to a bounded background writer queue. Serialization,
+fsync, checksums, and the directory-atomic commit all happen on the writer
+thread, overlapped with the next compiled chunk. Two backpressure
+policies when the queue is full:
+
+- ``block`` (default): `submit` waits for a slot — bounded memory, the
+  run throttles to disk speed (the checkpoint-grade choice);
+- ``drop_oldest``: the oldest queued snapshot is discarded and counted
+  (``igg_snapshots_total{result="dropped"}`` + a ``snapshot_drop`` flight
+  event) — bounded memory AND bounded stall, for visualization outputs
+  where freshness beats completeness. SINGLE-PROCESS only: each
+  process's queue fills at its own disk speed, so independent drops
+  would desynchronize the per-step shard sets across processes; the
+  constructor rejects it when ``jax.process_count() > 1``.
+
+On-disk layout: ``<root>/step_<NNNNNNNNNN>/`` in the PR-2 checkpoint
+container format (`utils/blockio.py` — ``shards_p<i>.npz`` keyed by block
+coordinates, ``meta.npz``, sha256 sidecars). Commit protocol per
+snapshot: every process stages into the SAME ``.tmp-step…`` directory
+(the staging name is derived from the step, so no cross-process broadcast
+is needed — background threads must not enter jax collectives); a
+process's sidecar appears only after its data file is fsync'ed, so
+process 0's writer thread polls for the full sidecar set, writes
+``meta.npz`` (the commit record), and renames the staging directory into
+place. A crash at ANY point leaves either a committed, checksum-complete
+snapshot or a stale ``.tmp-`` directory that `io.reader.list_snapshots`
+never lists — never a committed-but-corrupt one. A RE-attempt of the
+same step reuses the deterministic staging dir; each process unlinks its
+own stale sidecar before rewriting, so a prior aborted attempt's
+completion markers cannot satisfy the current commit poll mid-write.
+
+`write_snapshot` is the synchronous single-snapshot core (what the writer
+thread runs); it is also the honest baseline the async overhead is
+benchmarked against (`bench_io.py`).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..parallel.topology import check_initialized, global_grid
+from ..utils.blockio import (
+    META_PREFIX, commit_staged_dir, grid_meta, shard_key, starts_of,
+    validate_block_keys, write_npz_synced,
+)
+from ..utils.exceptions import InvalidArgumentError
+
+__all__ = ["SnapshotWriter", "write_snapshot", "snapshot_dirname"]
+
+_POLICIES = ("block", "drop_oldest")
+STEP_PREFIX = "step_"
+
+
+def snapshot_dirname(step: int) -> str:
+    """Directory name of the snapshot at ``step`` (zero-padded so lexical
+    order IS step order — `list_snapshots` relies on it)."""
+    return f"{STEP_PREFIX}{int(step):010d}"
+
+
+def _capture_shards(state: dict, fields=None) -> dict:
+    """The device->host part of a snapshot: copy this process's
+    addressable shard blocks (replica 0 only) plus everything the writer
+    thread needs to serialize WITHOUT touching jax or the live grid
+    (which may be re-initialized under it by an elastic restart)."""
+    import jax
+
+    from ..ops.alloc import device_put_g
+
+    check_initialized()
+    gg = global_grid()
+    if not isinstance(state, dict) or not state:
+        raise InvalidArgumentError(
+            "snapshot expects a non-empty dict of name -> stacked array.")
+    if fields is not None:
+        missing = [f for f in fields if f not in state]
+        if missing:
+            raise InvalidArgumentError(
+                f"snapshot fields {missing} are not in the state "
+                f"(have {list(state)}).")
+    names = list(state) if fields is None else list(fields)
+    validate_block_keys(dict.fromkeys(names), "snapshot")
+    blocks, shapes, dtypes = {}, {}, {}
+    nbytes = 0
+    for k in names:
+        v = state[k]
+        if not hasattr(v, "addressable_shards"):  # host array: shard first
+            v = device_put_g(v)
+        shapes[k] = tuple(int(s) for s in v.shape)
+        dtypes[k] = str(v.dtype)
+        for s in v.addressable_shards:
+            if getattr(s, "replica_id", 0) != 0:
+                continue
+            block = np.asarray(s.data)
+            blocks[shard_key(k, starts_of(s.index))] = block
+            nbytes += block.nbytes
+    return {
+        "names": names, "shapes": shapes, "dtypes": dtypes,
+        "blocks": blocks, "nbytes": nbytes,
+        "grid_meta": grid_meta(gg),
+        "pidx": int(jax.process_index()),
+        "nprocs_files": int(jax.process_count()),
+    }
+
+
+def _write_captured(root: str, step: int, cap: dict, *,
+                    commit_timeout: float = 120.0) -> tuple:
+    """Serialize one captured snapshot into ``<root>/step_<n>`` with the
+    staged-directory atomic commit. Pure host code — safe on a background
+    thread. Returns ``(path, committed)``: process 0 commits (path is the
+    final directory); other processes only stage their shard file — their
+    snapshot exists only once process 0's commit lands."""
+    final = os.path.join(root, snapshot_dirname(step))
+    token = snapshot_dirname(step)  # deterministic: no cross-process bcast
+    stage = f"{final}.tmp-{token}"
+    os.makedirs(stage, exist_ok=True)
+
+    payload = {f"{META_PREFIX}save_token": np.str_(token)}
+    payload.update(cap["blocks"])
+    shard_file = os.path.join(stage, f"shards_p{cap['pidx']}.npz")
+    # A re-attempt of the same step (rollback replay, or a retry after an
+    # aborted commit) reuses the deterministic stage dir: drop the OWN
+    # stale sidecar before touching the data file, so process 0's poll
+    # can never read a prior attempt's completion marker while this one
+    # is mid-write.
+    try:
+        os.unlink(shard_file + ".sha256")
+    except FileNotFoundError:
+        pass
+    write_npz_synced(shard_file, payload)
+    if cap["pidx"] != 0:
+        return stage, False
+
+    # Process 0 commits: wait for every process's sidecar (a sidecar is
+    # written only after its data file is fsync'ed — presence == complete),
+    # then write meta.npz (the commit record) and rename the set into
+    # place. Polling replaces the checkpoint path's barrier: a writer
+    # thread must never enter a jax collective.
+    deadline = time.monotonic() + commit_timeout
+    sidecars = [os.path.join(stage, f"shards_p{i}.npz.sha256")
+                for i in range(cap["nprocs_files"])]
+    while not all(os.path.exists(p) for p in sidecars):
+        if time.monotonic() > deadline:
+            raise InvalidArgumentError(
+                f"Snapshot commit timed out after {commit_timeout}s: "
+                f"missing {[p for p in sidecars if not os.path.exists(p)]} "
+                f"in {stage} — a peer process stalled or died; the staged "
+                "directory is left for inspection (it is never listed as "
+                "a snapshot).")
+        time.sleep(0.01)
+
+    meta = dict(cap["grid_meta"])
+    meta[f"{META_PREFIX}names"] = np.asarray(cap["names"])
+    meta[f"{META_PREFIX}save_token"] = np.str_(token)
+    meta[f"{META_PREFIX}nprocs_files"] = np.int64(cap["nprocs_files"])
+    meta[f"{META_PREFIX}checksums"] = np.str_("sha256")
+    meta[f"{META_PREFIX}step"] = np.int64(step)
+    meta[f"{META_PREFIX}kind"] = np.str_("snapshot")
+    for k in cap["names"]:
+        meta[f"{META_PREFIX}shape__{k}"] = np.asarray(cap["shapes"][k],
+                                                      dtype=np.int64)
+        meta[f"{META_PREFIX}dtype__{k}"] = np.str_(cap["dtypes"][k])
+    write_npz_synced(os.path.join(stage, "meta.npz"), meta)
+    # re-snapshot of the same step (rollback replay): the old committed
+    # dir is replaced whole (`blockio.commit_staged_dir`, shared with the
+    # checkpoint save)
+    commit_staged_dir(stage, final, token)
+    return final, True
+
+
+def write_snapshot(root, state: dict, *, step: int, fields=None,
+                   commit_timeout: float = 120.0) -> str:
+    """Synchronously write one snapshot of ``state`` under ``root``
+    (directory ``<root>/step_<n>``). The synchronous core of
+    `SnapshotWriter` — same container, same commit protocol, no queue.
+    Collective only in the filesystem sense: in multi-host runs every
+    process must call it for the commit to complete. Returns the
+    snapshot path."""
+    os.makedirs(str(root), exist_ok=True)
+    cap = _capture_shards(state, fields)
+    _write_captured(str(root), int(step), cap,
+                    commit_timeout=commit_timeout)
+    # the FINAL path on every process — non-root processes only staged,
+    # but the committed directory name is deterministic
+    return os.path.join(str(root), snapshot_dirname(int(step)))
+
+
+class SnapshotWriter:
+    """Bounded-queue async snapshot writer (module docstring has the
+    full protocol). One writer owns one ``root`` directory; `submit`
+    is called from the driver loop, everything else happens on a daemon
+    writer thread. Thread-safe; `close` (or context-manager exit) drains
+    the queue."""
+
+    def __init__(self, root, *, queue_depth: int = 2,
+                 policy: str = "block", fields=None,
+                 commit_timeout: float = 120.0):
+        import jax
+
+        if policy not in _POLICIES:
+            raise InvalidArgumentError(
+                f"SnapshotWriter policy must be one of {_POLICIES}; "
+                f"got {policy!r}.")
+        if policy == "drop_oldest" and jax.process_count() > 1:
+            # each process's queue fills at its own disk speed, so drop
+            # decisions would desynchronize the per-step shard sets and
+            # stall every commit against its timeout — only the lockstep
+            # `block` policy is sound across processes
+            raise InvalidArgumentError(
+                "SnapshotWriter policy='drop_oldest' is single-process "
+                "only: multi-host runs must use policy='block' so every "
+                "process stages the same snapshot sequence.")
+        if int(queue_depth) < 1:
+            raise InvalidArgumentError(
+                f"SnapshotWriter queue_depth must be >= 1; got "
+                f"{queue_depth}.")
+        self.root = str(root)
+        self.policy = policy
+        self.queue_depth = int(queue_depth)
+        self.fields = None if fields is None else tuple(fields)
+        self.commit_timeout = float(commit_timeout)
+        os.makedirs(self.root, exist_ok=True)
+        self._cv = threading.Condition()
+        self._queue: list = []     # [(step, captured)] oldest first
+        self._busy = False         # writer thread mid-write
+        self._closed = False
+        self._stats = {"submitted": 0, "written": 0, "staged": 0,
+                       "dropped": 0, "errors": 0, "bytes": 0}
+        self._thread = threading.Thread(
+            target=self._run, name="igg-snapshot-writer", daemon=True)
+        self._thread.start()
+
+    # -- producer side ----------------------------------------------------
+
+    def submit(self, state: dict, step: int) -> bool:
+        """Snapshot ``state`` at ``step``: device->host copy now, disk on
+        the writer thread. Returns False iff the job displaced the oldest
+        queued snapshot (``drop_oldest`` under a full queue)."""
+        from ..telemetry.hooks import note_io_queue, observe_snapshot
+
+        cap = _capture_shards(state, self.fields)
+        dropped = None
+        with self._cv:
+            if self._closed:
+                raise InvalidArgumentError(
+                    "SnapshotWriter is closed; create a new one.")
+            while (self.policy == "block"
+                   and len(self._queue) >= self.queue_depth
+                   and not self._closed):
+                self._cv.wait()
+            if self._closed:
+                raise InvalidArgumentError(
+                    "SnapshotWriter was closed while waiting for a queue "
+                    "slot; the snapshot was not submitted.")
+            if len(self._queue) >= self.queue_depth:  # drop_oldest
+                dropped = self._queue.pop(0)
+                self._stats["dropped"] += 1
+            self._queue.append((int(step), cap))
+            self._stats["submitted"] += 1
+            depth = len(self._queue)
+            self._cv.notify_all()
+        note_io_queue(depth)
+        if dropped is not None:
+            observe_snapshot("dropped", step=dropped[0],
+                             path=os.path.join(
+                                 self.root, snapshot_dirname(dropped[0])),
+                             queue_depth=depth)
+        return dropped is None
+
+    def flush(self, timeout: float | None = None) -> bool:
+        """Wait until every submitted snapshot is on disk (or dropped).
+        Returns False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._queue or self._busy:
+                rem = None if deadline is None \
+                    else max(0.0, deadline - time.monotonic())
+                if rem == 0.0:
+                    return False
+                self._cv.wait(timeout=rem)
+        return True
+
+    def close(self, timeout: float | None = None) -> bool:
+        """Drain and stop the writer thread (idempotent). Returns the
+        `flush` verdict."""
+        ok = self.flush(timeout)
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5.0)
+        return ok
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    @property
+    def stats(self) -> dict:
+        """Counters snapshot: submitted / written (COMMITTED — process 0
+        only in multi-host runs) / staged (non-root shard files handed to
+        process 0's commit) / dropped / errors / bytes (committed payload
+        bytes, this process's blocks)."""
+        with self._cv:
+            return dict(self._stats)
+
+    # -- writer thread -----------------------------------------------------
+
+    def _run(self) -> None:
+        from ..telemetry.hooks import note_io_queue, observe_snapshot
+        from ..telemetry.recorder import record_event
+
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if not self._queue:  # closed and drained
+                    return
+                step, cap = self._queue.pop(0)
+                self._busy = True
+                depth = len(self._queue)
+                self._cv.notify_all()
+            note_io_queue(depth)
+            t0 = time.monotonic()
+            try:
+                path, committed = _write_captured(
+                    self.root, step, cap,
+                    commit_timeout=self.commit_timeout)
+            except Exception as e:  # never kill the run from the writer
+                with self._cv:
+                    self._stats["errors"] += 1
+                    self._busy = False
+                    self._cv.notify_all()
+                observe_snapshot(
+                    "error", step=step,
+                    path=os.path.join(self.root, snapshot_dirname(step)),
+                    error=f"{e.__class__.__name__}: {e}")
+                continue
+            dur = time.monotonic() - t0
+            # only a COMMITTED snapshot counts as written: a non-root
+            # process merely staged its shard file — claiming "written"
+            # here would over-count whenever process 0's commit later
+            # fails, telling operators a missing snapshot exists
+            slot = "written" if committed else "staged"
+            with self._cv:
+                self._stats[slot] += 1
+                if committed:
+                    self._stats["bytes"] += cap["nbytes"]
+                self._busy = False
+                self._cv.notify_all()
+            if committed:
+                observe_snapshot("written", dur_s=dur, step=step,
+                                 path=path, nbytes=cap["nbytes"],
+                                 queue_depth=depth)
+            else:
+                record_event("snapshot_stage", step=step, dur_s=dur,
+                             nbytes=cap["nbytes"])

@@ -12,6 +12,15 @@ semantics (`gather.jl:15-16`), only the ``root`` process returns the array.
 `gather_interior` additionally strips the overlap duplication and returns the
 true implicit global grid (size ``nxyz_g``) — the reference leaves this to
 user code (e.g. halo-strip before gather, `README.md:147-148`).
+
+COST: every gather materializes O(global) bytes on the ``root`` host (and
+the multi-host collective moves O(global) over DCN) while the step loop
+waits. Non-root processes participate in the collective but never
+materialize the result (`_to_host(want=False)`). For production output,
+prefer the O(shard)-per-process snapshot pipeline: `io.SnapshotWriter` /
+`run_resilient(snapshot_dir=...)` during the run, `io.open_snapshot` +
+`read_global(box=...)` (gather_interior-identical semantics, O(box)
+memory) on the analysis side — see `docs/io.md`.
 """
 
 from __future__ import annotations
@@ -25,7 +34,13 @@ from .fields import local_shape_of
 __all__ = ["gather", "gather_interior", "gather_sub"]
 
 
-def _to_host(A) -> np.ndarray:
+def _to_host(A, *, want: bool = True):
+    """Assemble ``A`` on the host; ``want=False`` (non-root callers) still
+    runs the COLLECTIVE part but skips the host materialization — before
+    this, every process of a multi-host run converted the
+    `process_allgather` result to a full O(global) numpy array only to
+    throw it away, multiplying the gather's footprint by the process
+    count."""
     import jax
 
     if not hasattr(A, "shape"):
@@ -33,7 +48,13 @@ def _to_host(A) -> np.ndarray:
     if hasattr(A, "is_fully_addressable") and not A.is_fully_addressable:
         from jax.experimental import multihost_utils
 
-        return np.asarray(multihost_utils.process_allgather(A, tiled=True))
+        g = multihost_utils.process_allgather(A, tiled=True)
+        if not want:
+            del g  # drop the replicated buffer without a numpy copy
+            return None
+        return np.asarray(g)
+    if not want:
+        return None
     return np.asarray(jax.device_get(A))
 
 
@@ -54,8 +75,9 @@ def gather(A, A_global=None, *, root: int = 0, layout: str | None = None):
 
     # NOTE: _to_host may be a COLLECTIVE in multi-host runs (process_allgather)
     # — it must run on every process before any root-only validation can
-    # raise, or non-root processes would hang in the collective.
-    host = _to_host(A)
+    # raise, or non-root processes would hang in the collective. Only the
+    # root materializes the O(global) result (want=).
+    host = _to_host(A, want=me == root)
     if me == root and A_global is not None:
         loc = local_shape_of(A.shape, layout)
         expected = tuple(
@@ -135,7 +157,7 @@ def gather_sub(A, box, A_global=None, *, root: int = 0,
         slice(ranges[d][0] * int(loc[d]), ranges[d][1] * int(loc[d]))
         for d in range(nd)
     )
-    host = _to_host(A[sl])
+    host = _to_host(A[sl], want=jax.process_index() == root)
     if jax.process_index() != root:
         return None
     sub = host
@@ -165,7 +187,7 @@ def gather_interior(A, *, root: int = 0, layout: str | None = None):
 
     check_initialized()
     gg = global_grid()
-    host = _to_host(A)
+    host = _to_host(A, want=jax.process_index() == root)
     if jax.process_index() != root:
         return None
 

@@ -142,7 +142,11 @@ def run_resilient(step_local, state: dict, nt: int, *,
                   checkpoint_dir=None, checkpoint_every: int | None = None,
                   guard=None, policy=None, faults=(),
                   on_report=None, check_vma: bool | None = None,
-                  unroll: int | None = None):
+                  unroll: int | None = None,
+                  snapshot_dir=None, snapshot_every: int | None = None,
+                  snapshot_fields=None, snapshot_queue: int = 2,
+                  snapshot_policy: str = "block",
+                  reducers=(), on_reduce=None):
     """Advance ``state`` by ``nt`` steps under health supervision with
     checkpoint-rollback recovery. Returns ``(state, reports)``.
 
@@ -164,7 +168,21 @@ def run_resilient(step_local, state: dict, nt: int, *,
     The chunk schedule is split at fault steps, so injections land at
     exact step boundaries; rollback recomputes from the last good save, so
     a recovered run's final state is bit-identical to an uninterrupted one
-    (asserted end-to-end in `tests/test_resilience.py`)."""
+    (asserted end-to-end in `tests/test_resilience.py`).
+
+    Output pipeline (the `implicitglobalgrid_tpu/io/` subsystem —
+    O(shard) per process, never a gather): ``snapshot_dir`` enables ASYNC
+    sharded snapshots every ``snapshot_every`` steps (default: every
+    chunk) — `io.SnapshotWriter` copies this process's shard blocks to
+    host at the boundary and a background thread commits them under
+    ``snapshot_dir`` (``snapshot_fields`` restricts which fields;
+    ``snapshot_queue``/``snapshot_policy`` bound the queue: ``block``
+    throttles, ``drop_oldest`` sheds). ``reducers`` takes `io.Probe` /
+    `io.AxisSlice` / `io.Stats` specs computed INSIDE the chunk program,
+    fused into the health guard's single psum (zero extra collectives);
+    decoded values stream to the flight recorder + metrics gauges and to
+    ``on_reduce(step, values)`` when given. Analysis side:
+    `io.open_snapshot` / `read_global`."""
     import numpy as np
 
     from ..parallel.topology import check_initialized
@@ -210,9 +228,37 @@ def run_resilient(step_local, state: dict, nt: int, *,
                     f"{f.name!r} of stacked shape {tuple(shape)}.")
     slots = (_CheckpointSlots(checkpoint_dir)
              if checkpoint_dir is not None else None)
+    writer = None
+    if snapshot_dir is not None:
+        from ..io.snapshot import SnapshotWriter
+
+        # validate the field selection NOW, not at the first cadence
+        # boundary — a typo'd name must fail before step 1, not 50000
+        # steps in
+        if snapshot_fields is not None:
+            unknown = [f for f in snapshot_fields if f not in state]
+            if unknown:
+                raise InvalidArgumentError(
+                    f"snapshot_fields {unknown} are not in the state "
+                    f"(have {names}).")
+        writer = SnapshotWriter(snapshot_dir, queue_depth=snapshot_queue,
+                                policy=snapshot_policy,
+                                fields=snapshot_fields)
+    elif snapshot_every is not None or snapshot_fields is not None \
+            or snapshot_policy != "block" or snapshot_queue != 2:
+        raise InvalidArgumentError(
+            "snapshot_every/snapshot_fields/snapshot_queue/"
+            "snapshot_policy need snapshot_dir to write into.")
+    snapshot_every = max(1, int(snapshot_every
+                                if snapshot_every is not None
+                                else cur_chunk))
+    reducers = tuple(reducers)
     record_event("run_begin", nt=nt, nt_chunk=cur_chunk,
                  checkpoint_every=checkpoint_every, names=names,
-                 checkpointing=slots is not None, faults=len(pending))
+                 checkpointing=slots is not None, faults=len(pending),
+                 snapshots=writer is not None,
+                 snapshot_every=snapshot_every if writer else None,
+                 reducers=len(reducers))
 
     def step_tuple(tup):
         out = step_local(dict(zip(names, tup)))
@@ -271,114 +317,162 @@ def run_resilient(step_local, state: dict, nt: int, *,
     if slots is not None:
         _save(state, 0)  # rollback is ALWAYS possible, even before step 1
 
-    while step < nt:
-        # --- faults due at this boundary (driver splits chunks on them) --
-        for f in [f for f in pending
-                  if isinstance(f, NaNPoke) and f.step == step]:
-            pending.remove(f)
-            state = dict(state)
-            state[f.name] = poke_nan(state[f.name], f.index)
-            record_event("fault_injected", fault="NaNPoke", step=f.step,
-                         name=f.name)
-        loss = next((f for f in pending
-                     if isinstance(f, ProcessLoss) and f.step == step), None)
-        if loss is not None:
-            pending.remove(loss)
-            record_event("fault_injected", fault="ProcessLoss",
-                         step=loss.step, new_dims=list(loss.new_dims))
+    try:
+        while step < nt:
+            # --- faults due at this boundary (chunks split on them) ------
+            for f in [f for f in pending
+                      if isinstance(f, NaNPoke) and f.step == step]:
+                pending.remove(f)
+                state = dict(state)
+                state[f.name] = poke_nan(state[f.name], f.index)
+                record_event("fault_injected", fault="NaNPoke", step=f.step,
+                             name=f.name)
+            loss = next((f for f in pending
+                         if isinstance(f, ProcessLoss) and f.step == step),
+                        None)
+            if loss is not None:
+                pending.remove(loss)
+                record_event("fault_injected", fault="ProcessLoss",
+                             step=loss.step, new_dims=list(loss.new_dims))
+                if slots is None:
+                    raise ResilienceError(
+                        "ProcessLoss injected with no checkpoint_dir — "
+                        "nothing to restart from.")
+                state, step = _elastic_recover(loss.new_dims)
+                profiling.record_health_event("elastic_restarts")
+                record_event("elastic_restart",
+                             new_dims=list(loss.new_dims), to_step=step)
+                # re-anchor the slots on the NEW decomposition right away,
+                # so a guard trip before the next cadence save rolls back
+                # onto the live grid instead of re-crossing the dims change
+                _save(state, step)
+                continue
+
+            # --- one supervised chunk ------------------------------------
+            nb = min(step + cur_chunk, nt)
+            if slots is not None:  # align to the checkpoint cadence
+                nb = min(nb,
+                         (step // checkpoint_every + 1) * checkpoint_every)
+            if writer is not None:  # ... and to the snapshot cadence
+                nb = min(nb, (step // snapshot_every + 1) * snapshot_every)
+            for f in pending:
+                if isinstance(f, (NaNPoke, ProcessLoss)) \
+                        and step < f.step < nb:
+                    nb = f.step
+            n = nb - step
+
+            ndims = tuple(state[k].ndim for k in names)
+            sizes = [int(np.prod(state[k].shape)) for k in names]
+            t_build0 = time.monotonic()
+            if reducers:
+                from ..io.reducers import build_reducer_plan, \
+                    make_reduced_post_chunk
+                from ..models.common import make_state_runner
+
+                # rebuilt per boundary (cheap host work): the ownership
+                # geometry follows the LIVE decomposition — an elastic
+                # restart changes it — and the plan signature joins the
+                # runner key, so stale compiled hooks can never serve
+                plan = build_reducer_plan(reducers, names, state)
+                runner = make_state_runner(
+                    step_tuple, ndims, nt_chunk=n,
+                    key=None if key is None
+                    else (key, "resilient-io", plan.signature),
+                    check_vma=check_vma, unroll=unroll,
+                    post_chunk=make_reduced_post_chunk(names, plan))
+            else:
+                plan = None
+                runner = make_guarded_runner(
+                    step_tuple, ndims, nt_chunk=n,
+                    key=None if key is None else (key, "resilient"),
+                    check_vma=check_vma, unroll=unroll)
+            t_exec0 = time.monotonic()
+            out = runner(*(state[k] for k in names))
+            # tiny replicated fetch = the chunk drain; with reducers the
+            # vector carries [health | reducer segments] from ONE psum
+            vec = np.asarray(out[-1])
+            t_done = time.monotonic()
+            rep = report_from_stats(vec[:2 * len(names)], names, sizes,
+                                    guard, chunk=chunk_idx,
+                                    step_begin=step, step_end=nb)
+            chunk_idx += 1
+            reports.append(rep)
+            profiling.record_health_event("chunks")
+            # exec_s covers dispatch through the stats fetch (= the chunk
+            # drain); a chunk right after a runner-cache miss also pays the
+            # XLA compile inside it — run_report flags those chunks as cold
+            record_event("chunk", chunk=rep.chunk, step_begin=step,
+                         step_end=nb, n=n, ok=rep.ok,
+                         reasons=list(rep.reasons),
+                         build_s=t_exec0 - t_build0,
+                         exec_s=t_done - t_exec0)
+            if plan is not None:
+                from ..telemetry.hooks import observe_reducers
+
+                values = plan.decode(vec[2 * len(names):])
+                observe_reducers(nb, values, ok=rep.ok)
+                if on_reduce is not None:
+                    on_reduce(nb, values)
+            if on_report is not None:
+                on_report(rep)
+
+            if rep.ok:
+                state = dict(zip(names, out[:-1]))
+                step = nb
+                retries = 0
+                # cadence saves, plus the TERMINAL state: without the
+                # latter a run whose nt is off-cadence could never be
+                # resumed from its own end
+                if slots is not None and (step % checkpoint_every == 0
+                                          or step >= nt):
+                    _save(state, step)
+                if writer is not None and (step % snapshot_every == 0
+                                           or step >= nt):
+                    kept = writer.submit(state, step)
+                    record_event("snapshot", step=step, displaced=not kept)
+                continue
+
+            # --- guard tripped: bounded-retry rollback -------------------
+            profiling.record_health_event("guard_trips")
+            retries += 1
+            record_event("guard_trip", step_end=nb,
+                         reasons=list(rep.reasons), retries=retries)
             if slots is None:
                 raise ResilienceError(
-                    "ProcessLoss injected with no checkpoint_dir — "
-                    "nothing to restart from.")
-            state, step = _elastic_recover(loss.new_dims)
-            profiling.record_health_event("elastic_restarts")
-            record_event("elastic_restart", new_dims=list(loss.new_dims),
-                         to_step=step)
-            # re-anchor the slots on the NEW decomposition right away, so
-            # a guard trip before the next cadence save rolls back onto
-            # the live grid instead of re-crossing the dims change
-            _save(state, step)
-            continue
+                    f"Health guard tripped at step {nb} "
+                    f"({', '.join(rep.reasons)}) and no checkpoint_dir is "
+                    "configured — cannot roll back.")
+            if retries > policy.max_retries:
+                raise ResilienceError(
+                    f"Health guard tripped {retries} consecutive times at "
+                    f"step {nb} ({', '.join(rep.reasons)}); retry budget "
+                    f"({policy.max_retries}) exhausted.")
+            if policy.backoff_s:
+                time.sleep(policy.backoff_s * 2 ** (retries - 1))
+            if retries >= policy.shrink_chunk_after \
+                    and cur_chunk > policy.min_nt_chunk:
+                cur_chunk = max(policy.min_nt_chunk, cur_chunk // 2)
+                profiling.record_health_event("escalations")
+                record_event("escalation", retries=retries,
+                             nt_chunk=cur_chunk, step=step)
+                if policy.on_escalate is not None:
+                    policy.on_escalate({"retries": retries,
+                                        "nt_chunk": cur_chunk,
+                                        "step": step})
+            state, step, fellback = slots.restore()
+            profiling.record_health_event("rollbacks")
+            profiling.record_health_event("restores")
+            if fellback:
+                profiling.record_health_event("restore_fallbacks")
+            record_event("rollback", to_step=step, fallback=fellback,
+                         retries=retries)
 
-        # --- one supervised chunk ----------------------------------------
-        nb = min(step + cur_chunk, nt)
-        if slots is not None:  # align boundaries to the checkpoint cadence
-            nb = min(nb, (step // checkpoint_every + 1) * checkpoint_every)
-        for f in pending:
-            if isinstance(f, (NaNPoke, ProcessLoss)) and step < f.step < nb:
-                nb = f.step
-        n = nb - step
-
-        ndims = tuple(state[k].ndim for k in names)
-        sizes = [int(np.prod(state[k].shape)) for k in names]
-        t_build0 = time.monotonic()
-        runner = make_guarded_runner(
-            step_tuple, ndims, nt_chunk=n,
-            key=None if key is None else (key, "resilient"),
-            check_vma=check_vma, unroll=unroll)
-        t_exec0 = time.monotonic()
-        out = runner(*(state[k] for k in names))
-        vec = np.asarray(out[-1])  # tiny replicated fetch = the chunk drain
-        t_done = time.monotonic()
-        rep = report_from_stats(vec, names, sizes, guard, chunk=chunk_idx,
-                                step_begin=step, step_end=nb)
-        chunk_idx += 1
-        reports.append(rep)
-        profiling.record_health_event("chunks")
-        # exec_s covers dispatch through the stats fetch (= the chunk
-        # drain); a chunk right after a runner-cache miss also pays the
-        # XLA compile inside it — run_report flags those chunks as cold.
-        record_event("chunk", chunk=rep.chunk, step_begin=step, step_end=nb,
-                     n=n, ok=rep.ok, reasons=list(rep.reasons),
-                     build_s=t_exec0 - t_build0, exec_s=t_done - t_exec0)
-        if on_report is not None:
-            on_report(rep)
-
-        if rep.ok:
-            state = dict(zip(names, out[:-1]))
-            step = nb
-            retries = 0
-            # cadence saves, plus the TERMINAL state: without the latter a
-            # run whose nt is off-cadence could never be resumed from its
-            # own end (it would replay from the last cadence save)
-            if slots is not None and (step % checkpoint_every == 0
-                                      or step >= nt):
-                _save(state, step)
-            continue
-
-        # --- guard tripped: bounded-retry rollback -----------------------
-        profiling.record_health_event("guard_trips")
-        retries += 1
-        record_event("guard_trip", step_end=nb, reasons=list(rep.reasons),
-                     retries=retries)
-        if slots is None:
-            raise ResilienceError(
-                f"Health guard tripped at step {nb} "
-                f"({', '.join(rep.reasons)}) and no checkpoint_dir is "
-                "configured — cannot roll back.")
-        if retries > policy.max_retries:
-            raise ResilienceError(
-                f"Health guard tripped {retries} consecutive times at "
-                f"step {nb} ({', '.join(rep.reasons)}); retry budget "
-                f"({policy.max_retries}) exhausted.")
-        if policy.backoff_s:
-            time.sleep(policy.backoff_s * 2 ** (retries - 1))
-        if retries >= policy.shrink_chunk_after \
-                and cur_chunk > policy.min_nt_chunk:
-            cur_chunk = max(policy.min_nt_chunk, cur_chunk // 2)
-            profiling.record_health_event("escalations")
-            record_event("escalation", retries=retries, nt_chunk=cur_chunk,
-                         step=step)
-            if policy.on_escalate is not None:
-                policy.on_escalate({"retries": retries,
-                                    "nt_chunk": cur_chunk, "step": step})
-        state, step, fellback = slots.restore()
-        profiling.record_health_event("rollbacks")
-        profiling.record_health_event("restores")
-        if fellback:
-            profiling.record_health_event("restore_fallbacks")
-        record_event("rollback", to_step=step, fallback=fellback,
-                     retries=retries)
-
-    record_event("run_end", completed=step, chunks=chunk_idx)
+        record_event("run_end", completed=step, chunks=chunk_idx)
+    finally:
+        if writer is not None:
+            # drain on EVERY exit path (normal end, retry-budget
+            # ResilienceError, a user exception out of on_report): every
+            # submitted snapshot is on disk before the caller proceeds
+            writer.close()
+            record_event("snapshot_writer_close", **writer.stats)
     return sync(state), reports

@@ -27,7 +27,7 @@ import math
 from dataclasses import dataclass
 
 __all__ = ["GuardConfig", "HealthReport", "make_guarded_runner",
-           "health_stats_local", "report_from_stats"]
+           "health_stats_local", "health_parts_local", "report_from_stats"]
 
 
 @dataclass(frozen=True)
@@ -70,23 +70,33 @@ class HealthReport:
         return not self.reasons
 
 
-def health_stats_local(state) -> "jax.Array":  # noqa: F821
-    """The in-chunk guard probe (LOCAL blocks, inside shard_map): a
-    ``(2*nfields,)`` float32 vector ``[nonfinite_0, norm2_0, nonfinite_1,
-    …]`` summed over every shard with ONE `psum` over all mesh axes —
-    replicated on return, so the runner can emit it under a ``P()`` spec."""
+def health_parts_local(state) -> "jax.Array":  # noqa: F821
+    """This shard's PRE-psum guard contributions: the ``(2*nfields,)``
+    float32 vector ``[nonfinite_0, norm2_0, nonfinite_1, …]``. Factored
+    out of `health_stats_local` so the in-situ reducer hook
+    (`io/reducers.make_reduced_post_chunk`) can concatenate its own
+    segments and share the guard's single psum — reducers add ZERO extra
+    collectives to the chunk program."""
     import jax.numpy as jnp
-    from jax import lax
-
-    from ..parallel.topology import AXIS_NAMES
 
     parts = []
     for x in state:
         xf = x.astype(jnp.float32)
         parts.append(jnp.sum((~jnp.isfinite(x)).astype(jnp.float32)))
         parts.append(jnp.sum(xf * xf))
-    vec = jnp.stack(parts)
-    return lax.psum(vec, AXIS_NAMES)
+    return jnp.stack(parts)
+
+
+def health_stats_local(state) -> "jax.Array":  # noqa: F821
+    """The in-chunk guard probe (LOCAL blocks, inside shard_map): a
+    ``(2*nfields,)`` float32 vector ``[nonfinite_0, norm2_0, nonfinite_1,
+    …]`` summed over every shard with ONE `psum` over all mesh axes —
+    replicated on return, so the runner can emit it under a ``P()`` spec."""
+    from jax import lax
+
+    from ..parallel.topology import AXIS_NAMES
+
+    return lax.psum(health_parts_local(state), AXIS_NAMES)
 
 
 def make_guarded_runner(step_local, state_ndims, *, nt_chunk: int, key=None,

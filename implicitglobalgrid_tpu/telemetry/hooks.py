@@ -14,7 +14,8 @@ from .recorder import record_event
 from .registry import metrics_registry
 
 __all__ = ["note_runner_cache", "account_halo_exchange",
-           "observe_checkpoint"]
+           "observe_checkpoint", "observe_snapshot", "note_io_queue",
+           "observe_reducers"]
 
 # Metric family names (the exported contract; see docs/observability.md).
 RUNNER_CACHE = "igg_runner_cache_total"
@@ -23,6 +24,11 @@ HALO_PPERMUTES = "igg_halo_ppermutes_total"
 HALO_WIRE_BYTES = "igg_halo_wire_bytes_total"
 HALO_LOCAL_BYTES = "igg_halo_local_copy_bytes_total"
 CKPT_SECONDS = "igg_checkpoint_seconds"
+SNAP_TOTAL = "igg_snapshots_total"
+SNAP_BYTES = "igg_snapshot_bytes_total"
+SNAP_SECONDS = "igg_snapshot_seconds"
+IO_QUEUE_DEPTH = "igg_io_queue_depth"
+REDUCER_VALUE = "igg_reducer_value"
 
 
 def note_runner_cache(result: str, build_s: float | None = None) -> None:
@@ -83,3 +89,63 @@ def observe_checkpoint(op: str, dur_s: float, *, path: str,
         "checkpoint_restore"
     record_event(kind, op=op, dur_s=dur_s, path=str(path), step=step,
                  **fields)
+
+
+def observe_snapshot(result: str, dur_s: float | None = None, *,
+                     path: str, step=None, nbytes: int = 0,
+                     queue_depth=None, **fields) -> None:
+    """Record one async-snapshot outcome (``result``: ``written`` |
+    ``dropped`` | ``error``) from `io.snapshot.SnapshotWriter`. Bytes are
+    THIS process's committed shard payload (the O(shard) volume that
+    actually moved); the flight event kind is ``snapshot_write`` /
+    ``snapshot_drop`` / ``snapshot_error``."""
+    reg = metrics_registry()
+    reg.counter(SNAP_TOTAL, "Async snapshot outcomes.",
+                ("result",)).inc(1, result=result)
+    if result == "written":
+        if nbytes:
+            reg.counter(
+                SNAP_BYTES,
+                "Snapshot payload bytes written (this process's shard "
+                "blocks).").inc(nbytes)
+        if dur_s is not None:
+            reg.histogram(
+                SNAP_SECONDS,
+                "Background snapshot serialize+fsync+commit wall time."
+            ).observe(dur_s)
+        record_event("snapshot_write", step=step, path=str(path),
+                     dur_s=dur_s, nbytes=nbytes,
+                     queue_depth=queue_depth, **fields)
+    elif result == "dropped":
+        record_event("snapshot_drop", step=step, path=str(path),
+                     queue_depth=queue_depth, **fields)
+    else:
+        record_event("snapshot_error", step=step, path=str(path),
+                     **fields)
+
+
+def note_io_queue(depth: int) -> None:
+    """Track the snapshot writer's live queue depth (gauge: the
+    backpressure signal an operator watches before picking ``block`` vs
+    ``drop_oldest``)."""
+    metrics_registry().gauge(
+        IO_QUEUE_DEPTH,
+        "Snapshots queued for the background writer right now.").set(depth)
+
+
+def observe_reducers(step, values: dict, *, ok: bool = True) -> None:
+    """Record one chunk boundary's in-situ reducer results: scalar values
+    land in the ``igg_reducer_value`` gauge family (labeled by reducer
+    name; per-stat sub-labeled ``name:stat``), every value streams to the
+    flight recorder (``reducers`` event — slices included, they are
+    axis-sized)."""
+    g = metrics_registry().gauge(
+        REDUCER_VALUE,
+        "Latest in-situ reducer results (probes, stats).", ("name",))
+    for name, v in values.items():
+        if isinstance(v, dict):
+            for stat, sv in v.items():
+                g.set(sv, name=f"{name}:{stat}")
+        elif not hasattr(v, "__len__"):
+            g.set(float(v), name=name)
+    record_event("reducers", step=step, ok=ok, values=values)

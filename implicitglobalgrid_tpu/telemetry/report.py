@@ -35,6 +35,11 @@ _SEQ_FIELDS = {
     "checkpoint_save": ("op", "step", "dur_s"),
     "checkpoint_restore": ("op", "step", "dur_s"),
     "elastic_restart": ("new_dims", "to_step"),
+    "snapshot": ("step", "displaced"),
+    "snapshot_write": ("step", "dur_s", "nbytes", "queue_depth"),
+    "snapshot_drop": ("step", "queue_depth"),
+    "snapshot_error": ("step", "error"),
+    "reducers": ("step", "ok", "values"),
     "run_end": ("completed", "chunks"),
 }
 
@@ -88,6 +93,10 @@ def run_report(source, *, run_id: str | None = None,
     trips, escalations, elastic = [], [], []
     begin = end = None
     halo = {"exchanges": 0, "ppermutes": 0, "wire_bytes": 0}
+    io = {"snapshots_submitted": 0, "snapshots_written": 0,
+          "snapshots_staged": 0, "snapshots_dropped": 0,
+          "snapshot_errors": 0, "snapshot_bytes": 0,
+          "snapshot_write_s_total": 0.0, "reducer_points": 0}
     for e in evs:
         k = e.get("kind")
         if k == "runner_cache":
@@ -117,6 +126,20 @@ def run_report(source, *, run_id: str | None = None,
             halo["exchanges"] += 1
             halo["ppermutes"] += e.get("ppermutes", 0)
             halo["wire_bytes"] += e.get("wire_bytes", 0)
+        elif k == "snapshot":
+            io["snapshots_submitted"] += 1
+        elif k == "snapshot_write":
+            io["snapshots_written"] += 1
+            io["snapshot_bytes"] += e.get("nbytes", 0)
+            io["snapshot_write_s_total"] += e.get("dur_s", 0.0) or 0.0
+        elif k == "snapshot_stage":
+            io["snapshots_staged"] += 1
+        elif k == "snapshot_drop":
+            io["snapshots_dropped"] += 1
+        elif k == "snapshot_error":
+            io["snapshot_errors"] += 1
+        elif k == "reducers":
+            io["reducer_points"] += 1
         elif k == "run_begin":
             begin = e
         elif k == "run_end":
@@ -163,6 +186,7 @@ def run_report(source, *, run_id: str | None = None,
             {"new_dims": e.get("new_dims"), "to_step": e.get("to_step")}
             for e in elastic],
         "halo": halo,
+        "io": io,
         "sequence": sequence,
     }
     if include_metrics:

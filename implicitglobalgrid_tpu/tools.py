@@ -220,9 +220,19 @@ def _cli(argv=None) -> int:
     - ``prom`` — print the current process's Prometheus metrics snapshot
       (mostly useful under ``python -i`` / notebook sessions; scrapers of
       a LIVE run export `prometheus_snapshot()` themselves).
+    - ``snapshots <root>`` — list the COMMITTED snapshots under a
+      `SnapshotWriter` root: step, path, fields, implicit-global shapes,
+      on-disk bytes. Host-only (numpy meta reads, no grid, no
+      accelerator).
+    - ``probe <root|snapshot> <field> i [j [k]]`` — read one
+      implicit-global cell from every snapshot under a root (a point
+      time-series: ``step value`` lines) or from a single snapshot
+      directory; O(one shard block) per snapshot via
+      `io.Snapshot.read_point`, never the global array.
     """
     import argparse
     import json
+    import os
     import sys
 
     ap = argparse.ArgumentParser(
@@ -242,12 +252,65 @@ def _cli(argv=None) -> int:
     rp.add_argument("--no-metrics", action="store_true",
                     help="omit the (empty, post-hoc) registry snapshot")
     sub.add_parser("prom", help="Prometheus text-format metrics snapshot")
+    sp = sub.add_parser("snapshots",
+                        help="list committed snapshots under a root")
+    sp.add_argument("root", help="SnapshotWriter root directory")
+    sp.add_argument("--json", action="store_true",
+                    help="one JSON object per snapshot instead of a table")
+    pp = sub.add_parser(
+        "probe", help="point time-series from snapshots (O(1 block) "
+                      "reads, no grid, no gather)")
+    pp.add_argument("path", help="snapshot root (time series over every "
+                                 "snapshot) or a single snapshot dir")
+    pp.add_argument("field", help="field name in the snapshots")
+    pp.add_argument("index", nargs="+", type=int,
+                    help="implicit-global cell index (one per dimension)")
+    pp.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
     from .telemetry import prometheus_snapshot, run_report
 
     if args.cmd == "prom":
         sys.stdout.write(prometheus_snapshot())
+        return 0
+    if args.cmd == "snapshots":
+        from .io import list_snapshots, open_snapshot
+
+        for step, path in list_snapshots(args.root):
+            snap = open_snapshot(path)
+            nbytes = sum(
+                os.path.getsize(os.path.join(path, f))
+                for f in os.listdir(path)
+                if f.endswith(".npz"))
+            rec = {"step": step, "path": path, "fields": snap.names,
+                   "global_shapes": {n: list(snap.global_shape(n))
+                                     for n in snap.names},
+                   "bytes": nbytes}
+            if args.json:
+                print(json.dumps(rec))
+            else:
+                shapes = ", ".join(
+                    f"{n}{tuple(snap.global_shape(n))}"
+                    for n in snap.names)
+                print(f"step {step:>10}  {nbytes:>12} B  {shapes}  {path}")
+        return 0
+    if args.cmd == "probe":
+        from .io import list_snapshots, open_snapshot
+
+        if os.path.exists(os.path.join(args.path, "meta.npz")):
+            series = [(None, args.path)]
+        else:
+            series = list_snapshots(args.path)
+        for _step, path in series:
+            snap = open_snapshot(path)
+            v = snap.read_point(args.field, args.index)
+            step = snap.step if snap.step is not None else _step
+            if args.json:
+                print(json.dumps({"step": step, "field": args.field,
+                                  "index": list(args.index),
+                                  "value": float(v)}))
+            else:
+                print(f"{step} {float(v)!r}")
         return 0
     rep = run_report(args.jsonl, run_id=args.run_id, trace_dir=args.trace,
                      include_metrics=not args.no_metrics)

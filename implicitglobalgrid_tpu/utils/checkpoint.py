@@ -76,92 +76,30 @@ import numpy as np
 
 from ..parallel.topology import check_initialized, global_grid
 from ..utils.exceptions import IncoherentArgumentError, InvalidArgumentError
+from .blockio import (
+    ARR_PREFIX as _ARR_PREFIX,
+    META_PREFIX as _META_PREFIX,
+    block_scanner as _block_scanner,
+    commit_staged_dir as _commit_staged_dir,
+    grid_meta as _grid_meta,
+    load_prefixed_meta as _load_meta,
+    shard_key as _shard_key,
+    starts_of as _starts_of,
+    validate_block_keys as _validate_block_keys,
+    verify_checksum as _verify_checksum,
+    write_npz_synced as _write_npz_synced,
+)
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "load_checkpoint",
            "save_checkpoint_sharded", "restore_checkpoint_sharded",
            "restore_checkpoint_elastic", "saved_topology",
            "elastic_local_size"]
 
-_META_PREFIX = "__igg_meta__"
-_ARR_PREFIX = "__igg_arr__"
-
-
-def _grid_meta(gg) -> dict:
-    return {
-        f"{_META_PREFIX}nxyz": np.asarray(gg.nxyz, dtype=np.int64),
-        f"{_META_PREFIX}dims": np.asarray(gg.dims, dtype=np.int64),
-        f"{_META_PREFIX}overlaps": np.asarray(gg.overlaps, dtype=np.int64),
-        f"{_META_PREFIX}periods": np.asarray(gg.periods, dtype=np.int64),
-        f"{_META_PREFIX}halowidths": np.asarray(gg.halowidths, dtype=np.int64),
-    }
-
-
-# ---------------------------------------------------------------------------
-# File integrity: fsync'ed writes + sha256 content sidecars
-# ---------------------------------------------------------------------------
-
-def _file_sha256(path) -> str:
-    import hashlib
-
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
-    return h.hexdigest()
-
-
-def _write_npz_synced(path, payload: dict) -> None:
-    """`np.savez` to ``path`` with fsync, plus a ``<path>.sha256``
-    content-checksum sidecar (also fsync'ed) verified on restore."""
-    with open(path, "wb") as f:
-        np.savez(f, **payload)
-        f.flush()
-        os.fsync(f.fileno())
-    side = path + ".sha256"
-    with open(side + ".tmp", "w") as f:
-        f.write(_file_sha256(path) + "\n")
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(side + ".tmp", side)
-
-
-def _verify_checksum(path, *, required: bool) -> None:
-    """Compare ``path`` against its ``.sha256`` sidecar. ``required=False``
-    tolerates a MISSING sidecar (checkpoints from before the checksum
-    format); a PRESENT sidecar is always enforced."""
-    side = path + ".sha256"
-    if not os.path.exists(side):
-        if required:
-            raise IncoherentArgumentError(
-                f"Checkpoint file {path} has no .sha256 sidecar but the "
-                "save recorded checksums — the directory was tampered with "
-                "or partially copied; do not resume from it.")
-        return
-    with open(side) as f:
-        expect = f.read().strip()
-    got = _file_sha256(path)
-    if got != expect:
-        raise IncoherentArgumentError(
-            f"Checkpoint file {path} is corrupt: content checksum "
-            f"{got[:12]}… does not match the recorded {expect[:12]}… — the "
-            "file was truncated or bit-flipped after the save; restore "
-            "from another checkpoint.")
-
-
-def _fsync_dir(path) -> None:
-    """Durability for a commit rename (POSIX: the rename is only durable
-    once the parent directory is fsync'ed); best-effort on platforms
-    without directory fds."""
-    try:
-        fd = os.open(path, os.O_RDONLY)
-    except OSError:
-        return
-    try:
-        os.fsync(fd)
-    except OSError:
-        pass
-    finally:
-        os.close(fd)
+# The container format (shard_key block layout, meta/arr key prefixes,
+# fsync'ed writes + sha256 sidecars, staged-directory atomic commit) is
+# factored into `utils/blockio.py`, shared with the async snapshot pipeline
+# (`implicitglobalgrid_tpu/io/`) — one on-disk format, two durability
+# layers, and `io.open_snapshot` can read checkpoint directories too.
 
 
 def save_checkpoint(path, state: dict, *, step: int | None = None,
@@ -250,14 +188,6 @@ def _validate_topology(meta: dict, gg, strict: bool,
             )
 
 
-def _starts_of(index) -> tuple:
-    return tuple(int(sl.start or 0) for sl in index)
-
-
-def _shard_key(name: str, starts) -> str:
-    return f"{_ARR_PREFIX}{name}__" + "_".join(str(s) for s in starts)
-
-
 def save_checkpoint_sharded(dirpath, state: dict, *,
                             step: int | None = None) -> None:
     """Write ``state`` to directory ``dirpath`` with each process saving
@@ -276,15 +206,7 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
 
     check_initialized()
     t0 = time.monotonic()
-    if not isinstance(state, dict) or not state:
-        raise InvalidArgumentError(
-            "save_checkpoint_sharded expects a non-empty dict of "
-            "name -> array.")
-    for k in state:
-        if not isinstance(k, str) or k.startswith("__igg_") or "__" in k:
-            raise InvalidArgumentError(
-                f"Invalid state key {k!r}: keys must be strings without "
-                "'__' and not starting with '__igg_'.")
+    _validate_block_keys(state, "save_checkpoint_sharded")
     gg = global_grid()
     pidx = jax.process_index()
 
@@ -342,21 +264,11 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
         if step is not None:
             meta[f"{_META_PREFIX}step"] = np.int64(step)
         _write_npz_synced(os.path.join(stage, "meta.npz"), meta)
-        # Commit: the complete staging dir takes the final name (one
-        # rename). A pre-existing checkpoint is moved aside first and
-        # removed after the swap — stale shard files from an earlier save
-        # with MORE processes can no longer shadow the new state (the
-        # whole directory is replaced, not patched file-by-file).
-        old = None
-        if os.path.exists(dirpath):
-            old = f"{dirpath}.old-{token}"
-            os.rename(dirpath, old)
-        os.rename(stage, dirpath)
-        _fsync_dir(os.path.dirname(os.path.abspath(dirpath)) or ".")
-        if old is not None:
-            import shutil
-
-            shutil.rmtree(old, ignore_errors=True)
+        # Commit: the complete staging dir takes the final name in one
+        # rename (`blockio.commit_staged_dir`, shared with the snapshot
+        # writer) — stale shard files from an earlier save with MORE
+        # processes can no longer shadow the new state.
+        _commit_staged_dir(stage, dirpath, token)
 
     # Final barrier: no process returns (and possibly starts the NEXT
     # save, or reports the checkpoint usable) before the commit rename.
@@ -365,22 +277,6 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
 
     observe_checkpoint("save_sharded", time.monotonic() - t0, path=dirpath,
                        step=step)
-
-
-def _load_meta(dirpath) -> dict:
-    """Open + verify + prefix-strip ``meta.npz`` — the ONE meta-loading
-    path (shared by the restores and `saved_topology`). The file is
-    checksum-verified BEFORE parsing (a corrupt meta must raise the typed
-    error, not a raw zipfile one); ``required=False`` tolerates
-    pre-checksum-format saves, which have no sidecars at all."""
-    meta_path = os.path.join(dirpath, "meta.npz")
-    if not os.path.exists(meta_path):
-        raise InvalidArgumentError(
-            f"Sharded checkpoint meta not found: {meta_path}")
-    _verify_checksum(meta_path, required=False)
-    with np.load(meta_path) as z:
-        return {k[len(_META_PREFIX):]: z[k] for k in z.files
-                if k.startswith(_META_PREFIX)}
 
 
 def _sharded_meta_and_files(dirpath):
@@ -449,38 +345,6 @@ def _sharded_meta_and_files(dirpath):
         _verify_checksum(own, required=checksums)
         verified.add(own)
     return meta, files, checksums, verified
-
-
-def _block_scanner(files, wanted: set, checksums_required: bool,
-                   verified: set, *, pop: bool = True):
-    """Lazy scan over the shard files for the keys in ``wanted``: each file
-    is opened at most once (checksum-verified on first open) and each
-    found block cached, so host memory stays at this process' shard
-    volume even after a process->shard remap (the pod-scale guarantee).
-    ``pop=True`` drops a block once consumed (the plain restore's one
-    consumer per block); ``pop=False`` keeps it cached — the elastic
-    restore reuses one saved block for several live blocks."""
-
-    blocks: dict = {}
-    unscanned = list(files)
-
-    def find_block(key: str):
-        while key not in blocks and unscanned:
-            path = unscanned.pop(0)
-            if path not in verified:
-                _verify_checksum(path, required=checksums_required)
-                verified.add(path)
-            with np.load(path) as z:
-                for k in z.files:
-                    if k in wanted:
-                        blocks[k] = z[k]
-        if key not in blocks:
-            raise IncoherentArgumentError(
-                f"Sharded checkpoint is missing block `{key}` — was the "
-                "save interrupted, or written with a different topology?")
-        return blocks.pop(key) if pop else blocks[key]
-
-    return find_block
 
 
 def restore_checkpoint_sharded(dirpath, *, strict: bool = True,

@@ -518,6 +518,93 @@ def test_telemetry_leaves_chunk_program_untouched(tmp_path):
     assert len(out_on) == len(out_off) == 3  # T, Cp, stats vector
 
 
+def test_reducers_share_the_guard_psum():
+    """THE io wire claim (ISSUE 4): an enabled in-situ reducer set adds
+    ZERO extra collectives to the chunk program — probe, axis slice and
+    global min/max/mean/RMS segments concatenate into the health guard's
+    single tiny all-reduce (one psum total, f32[2N + R]), and the
+    exchange's permute count is untouched."""
+    from implicitglobalgrid_tpu.io.reducers import (
+        AxisSlice, Probe, Stats, build_reducer_plan,
+        make_reduced_post_chunk,
+    )
+    from implicitglobalgrid_tpu.models import (
+        diffusion_step_local, init_diffusion3d,
+    )
+    from implicitglobalgrid_tpu.models.common import make_state_runner
+    from implicitglobalgrid_tpu.runtime.health import make_guarded_runner
+
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    T, Cp, p = init_diffusion3d(dtype=np.float32)
+
+    def step(s):
+        return (diffusion_step_local(s[0], s[1], p, "xla"), s[1])
+
+    names = ("T", "Cp")
+    reducers = [Probe("T", (0, 0, 0)), AxisSlice("T", 0, (0, 1, 1)),
+                Stats("T")]
+    plan = build_reducer_plan(reducers, names,
+                              {"T": T, "Cp": Cp})
+    guarded = make_guarded_runner(step, (3, 3), nt_chunk=2,
+                                  key="hlo_io_plain")
+    reduced = make_state_runner(
+        step, (3, 3), nt_chunk=2, key=("hlo_io_red", plan.signature),
+        post_chunk=make_reduced_post_chunk(names, plan))
+    hlo_g = guarded.lower(T, Cp).compile().as_text()
+    hlo_r = reduced.lower(T, Cp).compile().as_text()
+    assert _count_all_reduces(hlo_g) == _count_all_reduces(hlo_r) == 1
+    assert (_count_collective_permutes(hlo_r)
+            == _count_collective_permutes(hlo_g))
+    assert "all-gather" not in hlo_r and "all-to-all" not in hlo_r
+    # the ONE collective's payload is the combined stats vector:
+    # 2 fields * 2 health entries + probe(1) + slice(12: the implicit
+    # global x-size, 2*(8-2) periodic) + stats(2 + 2*8 min/max slots)
+    # = 4 + 1 + 12 + 18 = 35 floats
+    n = 2 * len(names) + plan.length
+    assert plan.length == 1 + 12 + 2 + 2 * 8
+    lines = [ln for ln in hlo_r.splitlines()
+             if re.search(r"= \S* ?all-reduce(-start)?\(", ln)]
+    assert lines and all(f"f32[{n}]" in ln for ln in lines), lines
+
+
+def test_snapshot_writer_leaves_chunk_program_untouched(tmp_path):
+    """Enabling snapshots adds ZERO collectives: with an ACTIVE
+    SnapshotWriter (submitting, queue draining) the guarded chunk
+    program compiles to identical collective counts and an identical
+    fetch surface as with snapshots off — the writer only ever sees the
+    host copies `submit` makes at chunk boundaries."""
+    import re as _re
+
+    from implicitglobalgrid_tpu.io import SnapshotWriter
+    from implicitglobalgrid_tpu.models import (
+        diffusion_step_local, init_diffusion3d,
+    )
+    from implicitglobalgrid_tpu.runtime.health import make_guarded_runner
+
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    T, Cp, p = init_diffusion3d(dtype=np.float32)
+
+    def step(s):
+        return (diffusion_step_local(s[0], s[1], p, "xla"), s[1])
+
+    off = make_guarded_runner(step, (3, 3), nt_chunk=2, key="hlo_snap_off")
+    hlo_off = off.lower(T, Cp).compile().as_text()
+    with SnapshotWriter(tmp_path / "s") as w:
+        w.submit({"T": T, "Cp": Cp}, 0)
+        on = make_guarded_runner(step, (3, 3), nt_chunk=2,
+                                 key="hlo_snap_on")
+        hlo_on = on.lower(T, Cp).compile().as_text()
+        w.flush(timeout=30.0)
+    assert (_count_collective_permutes(hlo_on)
+            == _count_collective_permutes(hlo_off))
+    assert _count_all_reduces(hlo_on) == _count_all_reduces(hlo_off) == 1
+    for pat in (r"= \S+ parameter\(", r"infeed", r"outfeed"):
+        assert (len(_re.findall(pat, hlo_on))
+                == len(_re.findall(pat, hlo_off)))
+
+
 def test_permute_count_with_halowidth_2():
     """halowidth>1 exchanges still cost one pair per axis (slab width is
     static, not a per-row loop)."""

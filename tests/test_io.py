@@ -1,0 +1,453 @@
+"""Tests of the sharded snapshot & in-situ analysis pipeline (`io/`).
+
+The tentpole contracts (ISSUE 4 acceptance):
+
+- `read_global` of a written snapshot is BIT-IDENTICAL to
+  `gather_interior` on the same state — including periodic dims and
+  staggered fields — and sub-box reads equal the matching slice;
+- an interrupted writer never leaves a committed-but-corrupt snapshot
+  (staged-rename commit; checksum-verified reads);
+- the async writer keeps the step loop off the disk path: bounded queue,
+  `block`/`drop_oldest` backpressure, drained on close;
+- in-situ reducers (probe / axis slice / global stats) match the values
+  a gather-based analysis would compute, with ZERO gathers (their wire
+  cost is audited in tests/test_hlo_audit.py);
+- the events surface in `igg.run_report` and the `tools` CLI.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu import io as iggio
+from implicitglobalgrid_tpu.utils.exceptions import (
+    IncoherentArgumentError, InvalidArgumentError,
+)
+
+pytestmark = pytest.mark.io
+
+
+def _encoded(dtype=np.float64):
+    """Coordinate-encoded field: cell value identifies its global cell
+    (same idiom as tests/test_gather.py)."""
+    A = igg.zeros_g(dtype=dtype)
+    cs = igg.coords_g(1.0, 1.0, 1.0, A)
+    enc = sum(np.asarray(c) * 10.0 ** (3 * d) for d, c in enumerate(cs))
+    return igg.device_put_g((enc + np.zeros(A.shape)).astype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# Reader vs gather_interior: the bit-identity contract
+# ---------------------------------------------------------------------------
+
+def test_read_global_bit_identical_nonperiodic(tmp_path):
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, quiet=True)
+    P = igg.update_halo(_encoded())
+    path = iggio.write_snapshot(tmp_path / "snaps", {"T": P}, step=7)
+    snap = iggio.open_snapshot(path)
+    assert snap.step == 7 and snap.names == ["T"]
+    GI = igg.gather_interior(P)
+    assert snap.global_shape("T") == GI.shape
+    G = snap.read_global("T")
+    assert G.dtype == GI.dtype
+    assert np.array_equal(G, GI)
+    # O(box) sub-reads equal the matching slice of the implicit grid
+    box = ((1, 4), (0, 8), (5, 8))
+    assert np.array_equal(snap.read_global("T", box=box),
+                          GI[1:4, 0:8, 5:8])
+    assert snap.read_point("T", (3, 4, 5)) == GI[3, 4, 5]
+
+
+def test_read_global_bit_identical_periodic(tmp_path):
+    """The acceptance case: periodic dims — ghost shift and wrap must
+    reproduce gather_interior exactly."""
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    P = igg.update_halo(_encoded())
+    path = iggio.write_snapshot(tmp_path / "snaps", {"T": P}, step=1)
+    snap = iggio.open_snapshot(path)
+    GI = igg.gather_interior(P)
+    assert GI.shape == (6, 6, 6)
+    assert np.array_equal(snap.read_global("T"), GI)
+    assert np.array_equal(snap.read_global("T", box=((4, 6), None, (0, 1))),
+                          GI[4:6, :, 0:1])
+
+
+def test_read_global_mixed_periodic_and_staggered(tmp_path):
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2,
+                         periodx=1, quiet=True)
+    T = igg.update_halo(_encoded(np.float32))
+    Vx = igg.device_put_g(  # x-staggered: local (6,5,5), stacked (12,10,10)
+        np.random.default_rng(0).normal(size=(12, 10, 10))
+        .astype(np.float32))
+    path = iggio.write_snapshot(tmp_path / "s", {"T": T, "Vx": Vx}, step=0)
+    snap = iggio.open_snapshot(path)
+    for name, arr in (("T", T), ("Vx", Vx)):
+        GI = igg.gather_interior(arr)
+        assert snap.global_shape(name) == GI.shape
+        assert np.array_equal(snap.read_global(name), GI)
+
+
+def test_reader_is_host_only(tmp_path):
+    """Analysis-side contract: reads work with NO initialized grid (the
+    topology travels in meta.npz)."""
+    igg.init_global_grid(5, 5, 5, dimx=2, dimy=2, dimz=2, quiet=True)
+    P = igg.update_halo(_encoded())
+    GI = igg.gather_interior(P)
+    path = iggio.write_snapshot(tmp_path / "snaps", {"T": P}, step=3)
+    igg.finalize_global_grid()
+    snap = iggio.open_snapshot(path)
+    assert np.array_equal(snap.read_global("T"), GI)
+    topo = snap.topology()
+    assert list(topo["dims"]) == [2, 2, 2] and topo["step"] == 3
+
+
+def test_reader_opens_checkpoint_dirs(tmp_path):
+    """Snapshots share the PR-2 checkpoint container, so the lazy reader
+    is also the post-hoc analysis path for sharded checkpoints."""
+    igg.init_global_grid(4, 4, 4, dimx=2, dimy=2, dimz=2, quiet=True)
+    T = igg.update_halo(_encoded())
+    igg.save_checkpoint_sharded(str(tmp_path / "ckpt"), {"T": T}, step=9)
+    GI = igg.gather_interior(T)
+    snap = iggio.open_snapshot(tmp_path / "ckpt")
+    assert snap.step == 9
+    assert np.array_equal(snap.read_global("T"), GI)
+
+
+# ---------------------------------------------------------------------------
+# Durability: commit protocol + checksums
+# ---------------------------------------------------------------------------
+
+def test_interrupted_writer_leaves_no_committed_snapshot(tmp_path, monkeypatch):
+    """Kill the writer before the meta.npz commit record: the staged
+    directory must never surface as a snapshot."""
+    from implicitglobalgrid_tpu.io import snapshot as snap_mod
+
+    igg.init_global_grid(4, 4, 4, dimx=2, dimy=2, dimz=2, quiet=True)
+    T = igg.ones_g()
+    root = tmp_path / "snaps"
+
+    orig = snap_mod.write_npz_synced
+
+    def dying(path, payload):
+        if os.path.basename(path) == "meta.npz":
+            raise OSError("simulated crash before commit")
+        return orig(path, payload)
+
+    monkeypatch.setattr(snap_mod, "write_npz_synced", dying)
+    with pytest.raises(OSError):
+        iggio.write_snapshot(root, {"T": T}, step=5)
+    monkeypatch.setattr(snap_mod, "write_npz_synced", orig)
+
+    assert iggio.list_snapshots(root) == []  # nothing committed
+    with pytest.raises(InvalidArgumentError):
+        iggio.open_snapshot(root / "step_0000000005")
+    # the shard data staged before the crash is still there (forensics),
+    # clearly marked as uncommitted
+    assert any(".tmp-" in d for d in os.listdir(root))
+    # and a later successful snapshot of the same step commits cleanly
+    path = iggio.write_snapshot(root, {"T": T}, step=5)
+    assert iggio.list_snapshots(root) == [(5, path)]
+
+
+def test_corrupt_committed_snapshot_is_detected(tmp_path):
+    igg.init_global_grid(4, 4, 4, dimx=2, dimy=2, dimz=2, quiet=True)
+    T = igg.ones_g()
+    path = iggio.write_snapshot(tmp_path / "s", {"T": T}, step=0)
+    shard = os.path.join(path, "shards_p0.npz")
+    data = bytearray(open(shard, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    with open(shard, "wb") as f:
+        f.write(data)
+    snap = iggio.open_snapshot(path)  # meta is fine; blocks are not
+    with pytest.raises(IncoherentArgumentError):
+        snap.read_global("T")
+
+
+def test_list_snapshots_skips_foreign_entries(tmp_path):
+    igg.init_global_grid(4, 4, 4, dimx=2, dimy=2, dimz=2, quiet=True)
+    T = igg.ones_g()
+    root = tmp_path / "s"
+    path = iggio.write_snapshot(root, {"T": T}, step=2)
+    os.makedirs(root / "step_0000000009.tmp-x")     # staged leftovers
+    os.makedirs(root / "step_0000000008")           # no meta.npz commit
+    os.makedirs(root / "notasnap")
+    assert iggio.list_snapshots(root) == [(2, str(path))]
+
+
+# ---------------------------------------------------------------------------
+# Async writer: queue, backpressure, drain
+# ---------------------------------------------------------------------------
+
+def test_snapshot_writer_async_roundtrip(tmp_path):
+    igg.init_global_grid(4, 4, 4, dimx=2, dimy=2, dimz=2, quiet=True)
+    igg.reset_metrics()
+    T = igg.update_halo(_encoded())
+    with iggio.SnapshotWriter(tmp_path / "s", queue_depth=2) as w:
+        for step in (10, 20, 30):
+            assert w.submit({"T": T}, step)
+        assert w.flush(timeout=30.0)
+    assert [s for s, _ in iggio.list_snapshots(tmp_path / "s")] \
+        == [10, 20, 30]
+    st = w.stats
+    assert st["submitted"] == st["written"] == 3
+    assert st["dropped"] == st["errors"] == 0 and st["bytes"] > 0
+    snap = iggio.open_snapshot(iggio.list_snapshots(tmp_path / "s")[0][1])
+    assert np.array_equal(snap.read_global("T"), igg.gather_interior(T))
+    # telemetry: bytes counter and seconds histogram moved
+    reg = igg.metrics_registry()
+    assert reg.get("igg_snapshot_bytes_total").value() == st["bytes"]
+    assert reg.get("igg_snapshots_total").value(result="written") == 3
+
+
+def test_snapshot_writer_drop_oldest(tmp_path, monkeypatch):
+    """A stalled disk with policy=drop_oldest sheds the OLDEST queued
+    snapshot and keeps the newest — bounded memory, bounded stall."""
+    from implicitglobalgrid_tpu.io import snapshot as snap_mod
+
+    igg.init_global_grid(4, 4, 4, dimx=2, dimy=2, dimz=2, quiet=True)
+    igg.reset_metrics()
+    T = igg.ones_g()
+    gate = threading.Event()
+    orig = snap_mod._write_captured
+
+    def slow(root, step, cap, **kw):
+        gate.wait(timeout=30.0)
+        return orig(root, step, cap, **kw)
+
+    monkeypatch.setattr(snap_mod, "_write_captured", slow)
+    w = iggio.SnapshotWriter(tmp_path / "s", queue_depth=1,
+                             policy="drop_oldest")
+    try:
+        import time as _time
+
+        assert w.submit({"T": T}, 1)          # writer thread picks it up
+        for _ in range(500):                   # wait until it is mid-write
+            if w._busy:
+                break
+            _time.sleep(0.01)
+        assert w._busy                         # stalled inside the gate
+        assert w.submit({"T": T}, 2)           # queued
+        assert not w.submit({"T": T}, 3)       # displaces step 2
+        gate.set()
+        assert w.flush(timeout=30.0)
+    finally:
+        gate.set()
+        w.close(timeout=30.0)
+    steps = [s for s, _ in iggio.list_snapshots(tmp_path / "s")]
+    assert steps == [1, 3]
+    st = w.stats
+    assert st["dropped"] == 1 and st["written"] == 2
+    assert igg.metrics_registry().get("igg_snapshots_total") \
+        .value(result="dropped") == 1
+
+
+def test_snapshot_writer_block_policy_never_drops(tmp_path, monkeypatch):
+    from implicitglobalgrid_tpu.io import snapshot as snap_mod
+
+    igg.init_global_grid(4, 4, 4, dimx=2, dimy=2, dimz=2, quiet=True)
+    T = igg.ones_g()
+    orig = snap_mod._write_captured
+
+    def slow(root, step, cap, **kw):
+        import time as _time
+
+        _time.sleep(0.02)
+        return orig(root, step, cap, **kw)
+
+    monkeypatch.setattr(snap_mod, "_write_captured", slow)
+    with iggio.SnapshotWriter(tmp_path / "s", queue_depth=1,
+                              policy="block") as w:
+        for step in range(5):
+            assert w.submit({"T": T}, step)    # waits instead of dropping
+        assert w.flush(timeout=30.0)
+    assert w.stats["dropped"] == 0 and w.stats["written"] == 5
+    assert len(iggio.list_snapshots(tmp_path / "s")) == 5
+
+
+def test_snapshot_writer_validation(tmp_path):
+    igg.init_global_grid(4, 4, 4, dimx=2, dimy=2, dimz=2, quiet=True)
+    T = igg.ones_g()
+    with pytest.raises(InvalidArgumentError):
+        iggio.SnapshotWriter(tmp_path / "s", policy="nope")
+    with pytest.raises(InvalidArgumentError):
+        iggio.SnapshotWriter(tmp_path / "s", queue_depth=0)
+    with pytest.raises(InvalidArgumentError):
+        iggio.write_snapshot(tmp_path / "s", {}, step=0)
+    with pytest.raises(InvalidArgumentError):
+        iggio.write_snapshot(tmp_path / "s", {"T": T}, step=0,
+                             fields=("missing",))
+    w = iggio.SnapshotWriter(tmp_path / "s2")
+    w.close()
+    with pytest.raises(InvalidArgumentError):
+        w.submit({"T": T}, 0)
+
+
+# ---------------------------------------------------------------------------
+# In-situ reducers
+# ---------------------------------------------------------------------------
+
+def _diffusion_setup():
+    from implicitglobalgrid_tpu.models import (
+        diffusion_step_local, init_diffusion3d,
+    )
+
+    T, Cp, p = init_diffusion3d(dtype=np.float32)
+
+    def step(s):
+        return {"T": diffusion_step_local(s["T"], s["Cp"], p, "xla"),
+                "Cp": s["Cp"]}
+
+    return step, {"T": T, "Cp": Cp}
+
+
+def test_reducers_match_gather_analysis(tmp_path):
+    """Probe/slice/stats computed in-situ equal what a gather-based
+    analysis computes from the final state."""
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    step, state = _diffusion_setup()
+    seen = []
+    st, reports = igg.run_resilient(
+        step, state, 8, nt_chunk=4, key="io_red",
+        reducers=[iggio.Probe("T", (3, 4, 5)),
+                  iggio.AxisSlice("T", 1, (2, 0, 3), name="line"),
+                  iggio.Stats("T")],
+        on_reduce=lambda s, v: seen.append((s, v)))
+    assert [s for s, _ in seen] == [4, 8]
+    GI = igg.gather_interior(st["T"]).astype(np.float64)
+    s_, v = seen[-1]
+    assert v["probe:T@3,4,5"] == np.float32(GI[3, 4, 5])
+    assert np.allclose(v["line"], GI[2, :, 3], rtol=1e-6, atol=0)
+    stats = v["stats:T"]
+    assert stats["min"] == np.float32(GI.min())
+    assert stats["max"] == np.float32(GI.max())
+    assert abs(stats["mean"] - GI.mean()) < 1e-5 * max(1.0, abs(GI.mean()))
+    assert abs(stats["rms"] - np.sqrt((GI ** 2).mean())) \
+        < 1e-5 * np.sqrt((GI ** 2).mean())
+    # gauges carry the latest scalars
+    g = igg.metrics_registry().get("igg_reducer_value")
+    assert g.value(name="probe:T@3,4,5") == v["probe:T@3,4,5"]
+    assert g.value(name="stats:T:max") == stats["max"]
+
+
+def test_reducers_on_replicated_low_rank_field():
+    """Fields of rank < 3 are replicated over the unused mesh axes; the
+    replica guard must keep sums and probes single-counted."""
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=2, quiet=True)
+    A2 = igg.update_halo(igg.device_put_g(
+        np.random.default_rng(1).normal(size=(12, 12))
+        .astype(np.float32)))
+
+    def step(s):
+        return {"A": s["A"]}
+
+    seen = []
+    igg.run_resilient(step, {"A": A2}, 1, nt_chunk=1, key="io_red2d",
+                      reducers=[iggio.Probe("A", (5, 7)),
+                                iggio.Stats("A", which=("min", "max",
+                                                        "mean"))],
+                      on_reduce=lambda s, v: seen.append(v))
+    GI = igg.gather_interior(A2).astype(np.float64)
+    v = seen[-1]
+    assert v["probe:A@5,7"] == np.float32(GI[5, 7])
+    assert v["stats:A"]["min"] == np.float32(GI.min())
+    assert v["stats:A"]["max"] == np.float32(GI.max())
+    assert abs(v["stats:A"]["mean"] - GI.mean()) < 1e-6
+
+
+def test_reducer_validation():
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=2, quiet=True)
+    from implicitglobalgrid_tpu.io.reducers import build_reducer_plan
+
+    T = igg.ones_g()
+    with pytest.raises(InvalidArgumentError):
+        build_reducer_plan([iggio.Probe("missing", (0, 0, 0))],
+                           ["T"], {"T": T})
+    with pytest.raises(InvalidArgumentError):
+        build_reducer_plan([iggio.Probe("T", (0, 0))], ["T"], {"T": T})
+    with pytest.raises(InvalidArgumentError):
+        build_reducer_plan([iggio.Probe("T", (99, 0, 0))], ["T"], {"T": T})
+    with pytest.raises(InvalidArgumentError):
+        build_reducer_plan([iggio.AxisSlice("T", 5, (0, 0, 0))],
+                           ["T"], {"T": T})
+    with pytest.raises(InvalidArgumentError):
+        iggio.Stats("T", which=("median",))
+    with pytest.raises(InvalidArgumentError):
+        build_reducer_plan([iggio.Probe("T", (0, 0, 0), name="x"),
+                            iggio.Probe("T", (1, 1, 1), name="x")],
+                           ["T"], {"T": T})
+
+
+# ---------------------------------------------------------------------------
+# Driver integration: events, report, CLI, program identity
+# ---------------------------------------------------------------------------
+
+def test_run_resilient_snapshot_events_and_report(tmp_path, capsys):
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    step, state = _diffusion_setup()
+    jsonl = tmp_path / "fr.jsonl"
+    igg.start_flight_recorder(str(jsonl))
+    try:
+        st, _ = igg.run_resilient(
+            step, state, 12, nt_chunk=4, key="io_evt",
+            snapshot_dir=str(tmp_path / "snaps"), snapshot_every=4,
+            reducers=[iggio.Probe("T", (1, 1, 1))])
+    finally:
+        igg.stop_flight_recorder()
+    kinds = [e["kind"] for e in igg.read_flight_events(jsonl)]
+    for k in ("snapshot", "snapshot_write", "reducers",
+              "snapshot_writer_close"):
+        assert k in kinds, (k, kinds)
+    rep = igg.run_report(str(jsonl))
+    assert rep["io"]["snapshots_submitted"] == 3
+    assert rep["io"]["snapshots_written"] == 3
+    assert rep["io"]["snapshots_dropped"] == 0
+    assert rep["io"]["snapshot_bytes"] > 0
+    assert rep["io"]["reducer_points"] == 3
+    assert any(s["kind"] == "snapshot_write" for s in rep["sequence"])
+
+    # CLI: report surfaces io, snapshots lists, probe reads the series
+    from implicitglobalgrid_tpu.tools import _cli
+
+    assert _cli(["report", str(jsonl), "--no-metrics"]) == 0
+    out = capsys.readouterr().out
+    assert '"snapshots_written": 3' in out
+    assert _cli(["snapshots", str(tmp_path / "snaps")]) == 0
+    out = capsys.readouterr().out
+    assert out.count("step ") == 3 and "T(" in out
+    assert _cli(["probe", str(tmp_path / "snaps"), "T",
+                 "2", "3", "4"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 3 and lines[-1].startswith("12 ")
+    GI = igg.gather_interior(st["T"])
+    assert float(lines[-1].split()[1]) == pytest.approx(float(GI[2, 3, 4]))
+
+
+def test_snapshots_reuse_the_compiled_chunk(tmp_path):
+    """THE zero-collectives claim, program-identity form: a run WITH
+    snapshots reuses the exact compiled chunk of a run WITHOUT them
+    (same runner-cache key -> cache hit), so snapshots cannot have
+    changed the chunk program."""
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2, quiet=True)
+    step, state = _diffusion_setup()
+    igg.reset_metrics()
+    reg = igg.metrics_registry()
+    igg.run_resilient(step, dict(state), 4, nt_chunk=4, key="io_hit")
+    misses0 = reg.get("igg_runner_cache_total").value(result="miss")
+    igg.run_resilient(step, dict(state), 4, nt_chunk=4, key="io_hit",
+                      snapshot_dir=str(tmp_path / "s"), snapshot_every=4)
+    assert reg.get("igg_runner_cache_total").value(result="miss") == misses0
+    assert reg.get("igg_runner_cache_total").value(result="hit") >= 1
+    assert len(iggio.list_snapshots(tmp_path / "s")) == 1
+
+
+def test_snapshot_without_dir_rejected():
+    igg.init_global_grid(4, 4, 4, dimx=2, dimy=2, dimz=2, quiet=True)
+    T = igg.ones_g()
+    with pytest.raises(InvalidArgumentError):
+        igg.run_resilient(lambda s: s, {"T": T}, 1, snapshot_every=5)
