@@ -69,7 +69,10 @@ from .telemetry import (
     MetricsRegistry, metrics_registry, reset_metrics, prometheus_snapshot,
     FlightRecorder, start_flight_recorder, stop_flight_recorder,
     flight_recorder, record_event, record_span, read_flight_events,
-    run_report,
+    run_report, aggregate_flight, aggregate_events, straggler_report,
+    export_chrome_trace,
+    MetricsServer, start_metrics_server, stop_metrics_server,
+    metrics_server,
 )
 from . import io
 from .io import (
@@ -103,6 +106,12 @@ __all__ = [
     "prometheus_snapshot", "FlightRecorder", "start_flight_recorder",
     "stop_flight_recorder", "flight_recorder", "record_event",
     "record_span", "read_flight_events", "run_report", "halo_comm_plan",
+    # mesh-wide observability (cross-process aggregation, Perfetto export,
+    # straggler analysis, live metrics endpoint)
+    "aggregate_flight", "aggregate_events", "straggler_report",
+    "export_chrome_trace",
+    "MetricsServer", "start_metrics_server", "stop_metrics_server",
+    "metrics_server",
     # io (sharded snapshot & in-situ analysis pipeline)
     "io", "SnapshotWriter", "write_snapshot", "open_snapshot",
     "list_snapshots", "Probe", "AxisSlice", "Stats",

@@ -146,7 +146,9 @@ def run_resilient(step_local, state: dict, nt: int, *,
                   snapshot_dir=None, snapshot_every: int | None = None,
                   snapshot_fields=None, snapshot_queue: int = 2,
                   snapshot_policy: str = "block",
-                  reducers=(), on_reduce=None):
+                  reducers=(), on_reduce=None,
+                  metrics_port: int | None = None,
+                  healthz_max_age_s: float | None = None):
     """Advance ``state`` by ``nt`` steps under health supervision with
     checkpoint-rollback recovery. Returns ``(state, reports)``.
 
@@ -182,7 +184,18 @@ def run_resilient(step_local, state: dict, nt: int, *,
     fused into the health guard's single psum (zero extra collectives);
     decoded values stream to the flight recorder + metrics gauges and to
     ``on_reduce(step, values)`` when given. Analysis side:
-    `io.open_snapshot` / `read_global`."""
+    `io.open_snapshot` / `read_global`.
+
+    ``metrics_port`` (opt-in) starts the live metrics endpoint
+    (`telemetry.start_metrics_server`) for the duration of the run —
+    ``/metrics`` serves the Prometheus snapshot, ``/healthz`` the age of
+    the driver heartbeat; ``0`` binds an ephemeral port (read it from
+    ``igg.metrics_server().port``). ``healthz_max_age_s`` makes
+    ``/healthz`` return 503 when the heartbeat is older — the wedged-
+    driver restart signal a supervisor's HTTP probe acts on; size it to
+    a few chunk durations. Binds 127.0.0.1 — see the security note in
+    docs/observability.md. The heartbeat gauges themselves are stamped
+    at every chunk boundary whether or not a server runs."""
     import numpy as np
 
     from ..parallel.topology import check_initialized
@@ -226,39 +239,64 @@ def run_resilient(step_local, state: dict, nt: int, *,
                 raise InvalidArgumentError(
                     f"NaNPoke index {tuple(f.index)} is outside field "
                     f"{f.name!r} of stacked shape {tuple(shape)}.")
-    slots = (_CheckpointSlots(checkpoint_dir)
-             if checkpoint_dir is not None else None)
-    writer = None
-    if snapshot_dir is not None:
-        from ..io.snapshot import SnapshotWriter
+    # the live endpoint comes up FIRST: a port conflict must fail the call
+    # before any other resource (writer thread, checkpoint dirs) spins up
+    from ..telemetry.hooks import note_heartbeat
 
-        # validate the field selection NOW, not at the first cadence
-        # boundary — a typo'd name must fail before step 1, not 50000
-        # steps in
-        if snapshot_fields is not None:
-            unknown = [f for f in snapshot_fields if f not in state]
-            if unknown:
-                raise InvalidArgumentError(
-                    f"snapshot_fields {unknown} are not in the state "
-                    f"(have {names}).")
-        writer = SnapshotWriter(snapshot_dir, queue_depth=snapshot_queue,
-                                policy=snapshot_policy,
-                                fields=snapshot_fields)
-    elif snapshot_every is not None or snapshot_fields is not None \
-            or snapshot_policy != "block" or snapshot_queue != 2:
-        raise InvalidArgumentError(
-            "snapshot_every/snapshot_fields/snapshot_queue/"
-            "snapshot_policy need snapshot_dir to write into.")
-    snapshot_every = max(1, int(snapshot_every
-                                if snapshot_every is not None
-                                else cur_chunk))
     reducers = tuple(reducers)
-    record_event("run_begin", nt=nt, nt_chunk=cur_chunk,
-                 checkpoint_every=checkpoint_every, names=names,
-                 checkpointing=slots is not None, faults=len(pending),
-                 snapshots=writer is not None,
-                 snapshot_every=snapshot_every if writer else None,
-                 reducers=len(reducers))
+    server = None
+    if metrics_port is not None:
+        from ..telemetry.server import start_metrics_server
+
+        server = start_metrics_server(
+            int(metrics_port), healthz_max_age_s=healthz_max_age_s)
+    elif healthz_max_age_s is not None:
+        raise InvalidArgumentError(
+            "healthz_max_age_s needs metrics_port (it configures the "
+            "/healthz endpoint the driver starts).")
+    writer = None
+    try:
+        slots = (_CheckpointSlots(checkpoint_dir)
+                 if checkpoint_dir is not None else None)
+        if snapshot_dir is not None:
+            from ..io.snapshot import SnapshotWriter
+
+            # validate the field selection NOW, not at the first cadence
+            # boundary — a typo'd name must fail before step 1, not 50000
+            # steps in
+            if snapshot_fields is not None:
+                unknown = [f for f in snapshot_fields if f not in state]
+                if unknown:
+                    raise InvalidArgumentError(
+                        f"snapshot_fields {unknown} are not in the state "
+                        f"(have {names}).")
+            writer = SnapshotWriter(snapshot_dir,
+                                    queue_depth=snapshot_queue,
+                                    policy=snapshot_policy,
+                                    fields=snapshot_fields)
+        elif snapshot_every is not None or snapshot_fields is not None \
+                or snapshot_policy != "block" or snapshot_queue != 2:
+            raise InvalidArgumentError(
+                "snapshot_every/snapshot_fields/snapshot_queue/"
+                "snapshot_policy need snapshot_dir to write into.")
+        snapshot_every = max(1, int(snapshot_every
+                                    if snapshot_every is not None
+                                    else cur_chunk))
+        record_event("run_begin", nt=nt, nt_chunk=cur_chunk,
+                     checkpoint_every=checkpoint_every, names=names,
+                     checkpointing=slots is not None, faults=len(pending),
+                     snapshots=writer is not None,
+                     snapshot_every=snapshot_every if writer else None,
+                     reducers=len(reducers))
+    except BaseException:
+        # a failed setup must not leak the endpoint or the writer thread
+        if writer is not None:
+            writer.close()
+        if server is not None:
+            from ..telemetry.server import stop_metrics_server
+
+            stop_metrics_server()
+        raise
 
     def step_tuple(tup):
         out = step_local(dict(zip(names, tup)))
@@ -314,11 +352,14 @@ def run_resilient(step_local, state: dict, nt: int, *,
             "Elastic restart failed on every checkpoint slot:\n  "
             + "\n  ".join(errors))
 
-    if slots is not None:
-        _save(state, 0)  # rollback is ALWAYS possible, even before step 1
-
     try:
+        if slots is not None:
+            _save(state, 0)  # rollback ALWAYS possible, even before step 1
         while step < nt:
+            # liveness stamp at every boundary (normal commit, retry, and
+            # elastic-restart paths all come back through here): the
+            # /healthz age resets as long as the driver is making progress
+            note_heartbeat(step)
             # --- faults due at this boundary (chunks split on them) ------
             for f in [f for f in pending
                       if isinstance(f, NaNPoke) and f.step == step]:
@@ -467,8 +508,13 @@ def run_resilient(step_local, state: dict, nt: int, *,
             record_event("rollback", to_step=step, fallback=fellback,
                          retries=retries)
 
+        note_heartbeat(step)
         record_event("run_end", completed=step, chunks=chunk_idx)
     finally:
+        if server is not None:
+            from ..telemetry.server import stop_metrics_server
+
+            stop_metrics_server()
         if writer is not None:
             # drain on EVERY exit path (normal end, retry-budget
             # ResilienceError, a user exception out of on_report): every

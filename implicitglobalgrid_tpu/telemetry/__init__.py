@@ -18,15 +18,31 @@ reference's whole surface, SURVEY §5.4):
 - `report` — `run_report`: the unified record merging the flight log
   with `overlap_stats`/`op_breakdown`; also the `python -m
   implicitglobalgrid_tpu.tools report` CLI's engine.
+- `aggregate` — the MESH-wide view (ISSUE 5 tentpole):
+  `aggregate_flight` merges N per-process flight streams into one
+  clock-aligned sequence (offsets estimated post-hoc at the chunk-
+  boundary psum barriers — no new collectives) and `straggler_report`
+  attributes per-chunk barrier arrivals, flags persistent stragglers,
+  and summarizes wait/compute imbalance (`run_report`'s ``"mesh"``
+  section).
+- `trace_export` — `export_chrome_trace`: the merged stream as
+  Chrome/Perfetto trace-event JSON (one track per process, chunk/
+  checkpoint/snapshot spans, instant guard events, counter tracks).
+- `server` — `start_metrics_server`: opt-in stdlib HTTP thread serving
+  ``/metrics`` (Prometheus exposition) and ``/healthz`` (driver
+  heartbeat age); started by `run_resilient(metrics_port=...)`.
 
 All instrumentation is HOST-side: compiled chunk programs are unchanged
 (`tests/test_hlo_audit.py` proves identical collective and fetch counts)
 and the measured overhead sits under the 2% gate (`bench_telemetry.py`).
 """
 
+from .aggregate import (
+    aggregate_events, aggregate_flight, mesh_section, straggler_report,
+)
 from .export import prometheus_snapshot
-from .hooks import account_halo_exchange, note_runner_cache, \
-    observe_checkpoint
+from .hooks import account_halo_exchange, note_heartbeat, \
+    note_runner_cache, observe_checkpoint
 from .recorder import (
     FlightRecorder, flight_recorder, read_flight_events, record_event,
     record_span, start_flight_recorder, stop_flight_recorder,
@@ -36,6 +52,11 @@ from .registry import (
     metrics_registry, reset_metrics,
 )
 from .report import run_report
+from .server import (
+    MetricsServer, metrics_server, start_metrics_server,
+    stop_metrics_server,
+)
+from .trace_export import export_chrome_trace
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "DEFAULT_BUCKETS",
@@ -43,5 +64,10 @@ __all__ = [
     "FlightRecorder", "start_flight_recorder", "stop_flight_recorder",
     "flight_recorder", "record_event", "record_span", "read_flight_events",
     "prometheus_snapshot", "run_report",
+    "aggregate_flight", "aggregate_events", "straggler_report",
+    "mesh_section", "export_chrome_trace",
+    "MetricsServer", "start_metrics_server", "stop_metrics_server",
+    "metrics_server",
     "note_runner_cache", "account_halo_exchange", "observe_checkpoint",
+    "note_heartbeat",
 ]

@@ -10,12 +10,14 @@ module and a rename can never desynchronize producers.
 
 from __future__ import annotations
 
+import time
+
 from .recorder import record_event
 from .registry import metrics_registry
 
 __all__ = ["note_runner_cache", "account_halo_exchange",
            "observe_checkpoint", "observe_snapshot", "note_io_queue",
-           "observe_reducers"]
+           "observe_reducers", "note_heartbeat"]
 
 # Metric family names (the exported contract; see docs/observability.md).
 RUNNER_CACHE = "igg_runner_cache_total"
@@ -29,6 +31,8 @@ SNAP_BYTES = "igg_snapshot_bytes_total"
 SNAP_SECONDS = "igg_snapshot_seconds"
 IO_QUEUE_DEPTH = "igg_io_queue_depth"
 REDUCER_VALUE = "igg_reducer_value"
+HEARTBEAT_TS = "igg_driver_heartbeat_timestamp_seconds"
+HEARTBEAT_STEP = "igg_driver_step"
 
 
 def note_runner_cache(result: str, build_s: float | None = None) -> None:
@@ -131,6 +135,20 @@ def note_io_queue(depth: int) -> None:
     metrics_registry().gauge(
         IO_QUEUE_DEPTH,
         "Snapshots queued for the background writer right now.").set(depth)
+
+
+def note_heartbeat(step) -> None:
+    """Stamp the driver's liveness: wall time of the last completed chunk
+    boundary plus the last committed step. Two gauge writes (dict ops
+    under the registry lock) — the whole step-loop cost of the live
+    `/healthz` endpoint (`telemetry.server`), whether or not a server is
+    actually running."""
+    reg = metrics_registry()
+    reg.gauge(HEARTBEAT_TS,
+              "Wall-clock time of the resilient driver's last chunk "
+              "boundary (unix seconds).").set(time.time())
+    reg.gauge(HEARTBEAT_STEP,
+              "Last step the resilient driver committed.").set(step)
 
 
 def observe_reducers(step, values: dict, *, ok: bool = True) -> None:

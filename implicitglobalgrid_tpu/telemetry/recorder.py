@@ -76,17 +76,24 @@ class FlightRecorder:
     """Append-only JSONL event stream for one run.
 
     ``path`` may be a file path (created/appended) or an existing
-    directory, in which case a ``igg_run_<run_id>.jsonl`` file is created
-    inside it. ``run_id`` defaults to a fresh random token; it tags every
-    record, so several runs can share one file and still be separated by
-    `read_flight_events(path, run_id=...)`."""
+    directory, in which case the PER-PROCESS convention applies: a
+    ``flight_p<process_index>.jsonl`` file is created/appended inside it,
+    so N controllers recording into one shared directory never interleave
+    writers into one file — exactly the layout
+    `telemetry.aggregate.aggregate_flight(dir)` globs (``*.jsonl``) to
+    rebuild the mesh-wide view. In multi-controller runs open the
+    recorder AFTER ``jax.distributed.initialize`` (before it, every
+    controller reads process index 0 and would share one filename).
+    ``run_id`` defaults to a fresh random
+    token; it tags every record, so several runs can share one file and
+    still be separated by `read_flight_events(path, run_id=...)`."""
 
     def __init__(self, path, *, run_id: str | None = None):
         self.run_id = str(run_id) if run_id is not None else \
             secrets.token_hex(8)
         path = os.fspath(path)
         if os.path.isdir(path):
-            path = os.path.join(path, f"igg_run_{self.run_id}.jsonl")
+            path = os.path.join(path, f"flight_p{_process_index()}.jsonl")
         self.path = path
         self._lock = threading.Lock()
         self._pid = os.getpid()

@@ -39,6 +39,8 @@ _SEQ_FIELDS = {
     "snapshot_write": ("step", "dur_s", "nbytes", "queue_depth"),
     "snapshot_drop": ("step", "queue_depth"),
     "snapshot_error": ("step", "error"),
+    "snapshot_writer_close": ("submitted", "written", "staged", "dropped",
+                              "errors", "bytes"),
     "reducers": ("step", "ok", "values"),
     "run_end": ("completed", "chunks"),
 }
@@ -57,14 +59,32 @@ def run_report(source, *, run_id: str | None = None,
                include_metrics: bool = True) -> dict:
     """Build the unified report for one run.
 
-    ``source`` is a flight-recorder JSONL path or an iterable of already-
-    parsed event dicts. ``run_id`` selects a run when the file holds
-    several (default: the LAST run that appears). ``trace_dir`` merges a
-    profiler capture's `overlap_stats` and `op_breakdown`;
+    ``source`` is a flight-recorder JSONL path, a DIRECTORY of per-process
+    streams (the ``flight_p<i>.jsonl`` convention — aggregated and clock-
+    aligned via `telemetry.aggregate.aggregate_flight` first), or an
+    iterable of already-parsed event dicts. ``run_id`` selects a run when
+    the file holds several (default: the LAST run that appears; for a
+    directory, the single run present — several raise). ``trace_dir``
+    merges a profiler capture's `overlap_stats` and `op_breakdown`;
     ``include_metrics`` attaches a snapshot of the process metrics
     registry (meaningful in-process; the report CLI runs post-hoc, where
-    the registry is empty, and the JSONL carries the truth)."""
-    if isinstance(source, (str, os.PathLike)):
+    the registry is empty, and the JSONL carries the truth).
+
+    When the stream spans SEVERAL processes, the per-run sections below
+    reconstruct the ANCHOR process's view (the lowest index — every
+    process runs the same driver loop, so counting all of them would
+    multiply every aggregate by the process count) and a ``"mesh"``
+    section is added: clock offsets, per-chunk barrier-arrival straggler
+    attribution, persistent-straggler flags, and the wait/compute
+    imbalance summary (`telemetry.aggregate.mesh_section`)."""
+    agg = None
+    if isinstance(source, (str, os.PathLike)) \
+            and os.path.isdir(os.fspath(source)):
+        from .aggregate import aggregate_flight
+
+        agg = aggregate_flight(source, run_id=run_id)
+        events = agg["events"]
+    elif isinstance(source, (str, os.PathLike)):
         events = read_flight_events(source)
     else:
         events = list(source)
@@ -82,6 +102,24 @@ def run_report(source, *, run_id: str | None = None,
             f"run_report: run id {rid!r} not present (have {runs}).")
     evs = [e for e in events if e.get("run") == rid]
     evs.sort(key=lambda e: (e.get("proc", 0), e.get("seq", 0)))
+
+    # multi-process stream: cross-process analysis first, then reconstruct
+    # the anchor process's view (see docstring)
+    mesh = None
+    procs = sorted({int(e.get("proc", 0)) for e in evs})
+    if len(procs) > 1:
+        from .aggregate import aggregate_events, mesh_section
+
+        if agg is None:
+            # events arrived pre-loaded (a list, or one shared file):
+            # clock-align them first — per-process monotonic stamps are
+            # NOT comparable across hosts, and a straggler verdict on raw
+            # clocks would be silently wrong
+            agg = aggregate_events(evs, run_id=rid)
+        mesh = mesh_section(agg)
+        evs = [e for e in agg["events"]
+               if int(e.get("proc", 0)) == procs[0]]
+        evs.sort(key=lambda e: e.get("seq", 0))
 
     # Cold-chunk attribution: a chunk following a runner-cache miss pays
     # the XLA compile inside its first dispatch — the execute/compile
@@ -189,6 +227,8 @@ def run_report(source, *, run_id: str | None = None,
         "io": io,
         "sequence": sequence,
     }
+    if mesh is not None:
+        report["mesh"] = mesh
     if include_metrics:
         report["metrics"] = metrics_registry().collect()
     if trace_dir is not None:

@@ -1,0 +1,159 @@
+"""Live metrics endpoint: a stdlib-only HTTP thread serving the registry.
+
+Opt-in (`start_metrics_server(port)` or `run_resilient(metrics_port=...)`)
+and deliberately tiny — `http.server.ThreadingHTTPServer` on a daemon
+thread, zero dependencies, zero work on the step loop (the loop's only
+related cost is the driver's per-chunk heartbeat gauge, two dict writes;
+the serving happens entirely on the server's own threads when a scraper
+actually connects):
+
+- ``GET /metrics`` — `prometheus_snapshot()` of the process registry, in
+  the text exposition format any Prometheus/victoria/grafana-agent
+  scraper ingests directly;
+- ``GET /healthz`` — JSON liveness: the age of the driver's last
+  heartbeat (the ``igg_driver_heartbeat_timestamp_seconds`` gauge
+  `runtime/driver.py` sets at every chunk boundary) plus the last
+  committed step; returns 503 when ``healthz_max_age_s`` is set and the
+  heartbeat is older (a wedged driver stops heartbeating — the signal a
+  supervisor restarts on).
+
+SECURITY: binds ``127.0.0.1`` by default — the endpoint is unauthenticated
+by design (it exposes only metrics), so reach it from elsewhere via an
+SSH tunnel or an authenticating reverse proxy rather than binding
+``0.0.0.0`` (see docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.exceptions import InvalidArgumentError
+from .export import prometheus_snapshot
+from .hooks import HEARTBEAT_STEP, HEARTBEAT_TS
+from .registry import metrics_registry
+
+__all__ = ["MetricsServer", "start_metrics_server", "stop_metrics_server",
+           "metrics_server"]
+
+
+class MetricsServer:
+    """The running endpoint. ``port=0`` picks a free port (read ``.port``
+    after construction — the pattern tests and parallel launchers use).
+    Use as a context manager or call `close()`; the server thread is a
+    daemon either way, so a crashed run never hangs on it."""
+
+    def __init__(self, port: int = 0, *, host: str = "127.0.0.1",
+                 registry=None, healthz_max_age_s: float | None = None):
+        reg = registry if registry is not None else metrics_registry()
+        max_age = None if healthz_max_age_s is None \
+            else float(healthz_max_age_s)
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # no stderr chatter per scrape
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = prometheus_snapshot(reg).encode()
+                    self._send(200, body,
+                               "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/healthz":
+                    code, rec = outer._healthz()
+                    self._send(code, json.dumps(rec).encode(),
+                               "application/json")
+                else:
+                    self._send(404, b"not found\n", "text/plain")
+
+        self.registry = reg
+        self.healthz_max_age_s = max_age
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"igg-metrics-server:{self.port}", daemon=True)
+        self._thread.start()
+
+    def _healthz(self):
+        """(status_code, record): heartbeat age from the driver gauge."""
+        age = step = None
+        fam = self.registry.get(HEARTBEAT_TS)
+        if fam is not None:
+            samples = fam.samples()
+            if samples:
+                age = time.time() - samples[0][1]
+        fam = self.registry.get(HEARTBEAT_STEP)
+        if fam is not None:
+            samples = fam.samples()
+            if samples:
+                step = samples[0][1]
+        ok = True
+        if self.healthz_max_age_s is not None:
+            ok = age is not None and age <= self.healthz_max_age_s
+        return (200 if ok else 503), {
+            "ok": ok, "heartbeat_age_s": age, "step": step,
+            "max_age_s": self.healthz_max_age_s}
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+_current: MetricsServer | None = None
+_lock = threading.Lock()
+
+
+def start_metrics_server(port: int = 0, *, host: str = "127.0.0.1",
+                         registry=None,
+                         healthz_max_age_s: float | None = None
+                         ) -> MetricsServer:
+    """Start THE process metrics server (one per process — a second start
+    without a stop raises; scrapers address one stable port). ``port=0``
+    binds an ephemeral port, read it from the returned server's
+    ``.port``. Binds ``127.0.0.1`` unless ``host`` says otherwise (see
+    the module docstring's security note)."""
+    global _current
+    with _lock:
+        if _current is not None:
+            raise InvalidArgumentError(
+                f"A metrics server is already running on "
+                f"{_current.host}:{_current.port}; stop_metrics_server() "
+                "first.")
+        _current = MetricsServer(port, host=host, registry=registry,
+                                 healthz_max_age_s=healthz_max_age_s)
+        return _current
+
+
+def stop_metrics_server() -> None:
+    """Stop the process metrics server (no-op when none is running)."""
+    global _current
+    with _lock:
+        if _current is not None:
+            _current.close()
+            _current = None
+
+
+def metrics_server() -> MetricsServer | None:
+    """The running process metrics server, or None."""
+    return _current

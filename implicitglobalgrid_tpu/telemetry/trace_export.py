@@ -1,0 +1,201 @@
+"""Chrome/Perfetto trace-event export of an aggregated flight stream.
+
+`export_chrome_trace` renders the mesh-wide event sequence
+(`telemetry.aggregate.aggregate_flight`) as Trace Event Format JSON —
+the format both ``chrome://tracing`` and https://ui.perfetto.dev open
+directly — so a multi-process run becomes one navigable timeline:
+
+- one TRACK per process (trace ``pid`` = jax process index), with the
+  driver loop on thread 0 (``chunk`` spans nesting their ``build`` /
+  ``exec`` phases, checkpoint save/restore spans) and the background io
+  writer on thread 1 (``snapshot_write`` spans);
+- guard trips, rollbacks, escalations, elastic restarts, and fault
+  injections as INSTANT events (the red flags an operator scans for);
+- COUNTER tracks per process for ``igg_io_queue_depth`` (the writer's
+  live backpressure) and cumulative halo wire bytes.
+
+Timestamps are the aggregated stream's corrected wall clock (barrier-
+aligned across processes, `docs/observability.md` "Mesh-wide view"),
+rebased to the earliest event and expressed in microseconds as the
+format requires — so the per-process chunk spans line up at the chunk-
+boundary psum exactly as they did on the machine floor.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..utils.exceptions import InvalidArgumentError
+from .aggregate import aggregate_events, aggregate_flight
+from .recorder import read_flight_events
+
+__all__ = ["export_chrome_trace"]
+
+# Instant-event kinds (the operator's red flags), with the scope chrome
+# renders them at: process-wide bars.
+_INSTANTS = ("guard_trip", "rollback", "escalation", "elastic_restart",
+             "fault_injected", "snapshot_drop", "snapshot_error")
+
+_TID_DRIVER = 0
+_TID_IO = 1
+
+
+def _normalize(source, run_id):
+    """source -> (events, meta): an `aggregate_flight` result, a
+    directory/path-list (aggregated here), a single JSONL file, or an
+    already-merged event iterable. Pre-loaded events and single files
+    that turn out to span SEVERAL processes are clock-aligned too
+    (`aggregate_events`) — per-process monotonic stamps are not
+    comparable raw, and a Perfetto timeline drawn on them would be
+    silently uncorrelatable across tracks."""
+    if isinstance(source, dict):
+        if "events" not in source:
+            raise InvalidArgumentError(
+                "export_chrome_trace: dict source must be an "
+                "aggregate_flight result (no 'events' key).")
+        return source["events"], source
+    if isinstance(source, (str, os.PathLike)):
+        src = os.fspath(source)
+        if os.path.isdir(src):
+            agg = aggregate_flight(src, run_id=run_id)
+            return agg["events"], agg
+        evs = read_flight_events(src, run_id=run_id)
+    else:
+        evs = list(source)
+        if evs and isinstance(evs[0], (str, os.PathLike)):
+            agg = aggregate_flight(evs, run_id=run_id)
+            return agg["events"], agg
+    if len({int(e.get("proc", 0)) for e in evs}) > 1:
+        agg = aggregate_events(evs, run_id=run_id)
+        return agg["events"], agg
+    return evs, None
+
+
+def _args(e: dict, skip=("t", "t_mono", "t_offset", "kind", "run", "pid",
+                         "proc", "seq")) -> dict:
+    return {k: v for k, v in e.items() if k not in skip}
+
+
+def export_chrome_trace(source, out=None, *, run_id: str | None = None):
+    """Render ``source`` as Chrome trace-event JSON.
+
+    ``source``: an `aggregate_flight` result, a directory of per-process
+    ``*.jsonl`` streams (aggregated here), a list of stream paths, one
+    JSONL path, or an iterable of (already merged) event dicts.
+
+    With ``out`` (a path), writes the JSON there and returns the path;
+    otherwise returns the trace dict (``{"traceEvents": [...], ...}``).
+    Open the file at https://ui.perfetto.dev or ``chrome://tracing``."""
+    events, agg = _normalize(source, run_id)
+    if not events:
+        raise InvalidArgumentError("export_chrome_trace: no events.")
+    # rebase to the earliest point on the timeline — span STARTS included
+    # (an event's stamp is its END; its duration reaches back before it)
+    starts = []
+    for e in events:
+        if "t" not in e:
+            continue
+        t = float(e["t"])
+        for f in ("dur_s", "exec_s"):
+            t -= float(e.get(f, 0.0) or 0.0)
+        t -= float(e.get("build_s", 0.0) or 0.0) if "exec_s" in e else 0.0
+        starts.append(t)
+    t0 = min(starts)
+
+    def us(t: float) -> float:
+        return (float(t) - t0) * 1e6
+
+    trace: list = []
+    procs = sorted({int(e.get("proc", 0)) for e in events})
+    for p in procs:
+        trace.append({"ph": "M", "pid": p, "name": "process_name",
+                      "args": {"name": f"igg process {p}"}})
+        trace.append({"ph": "M", "pid": p, "tid": _TID_DRIVER,
+                      "name": "thread_name", "args": {"name": "driver"}})
+        trace.append({"ph": "M", "pid": p, "tid": _TID_IO,
+                      "name": "thread_name",
+                      "args": {"name": "io-writer"}})
+
+    wire_cum = {p: 0 for p in procs}
+    for e in events:
+        kind = e.get("kind")
+        if kind is None or "t" not in e:
+            continue
+        p = int(e.get("proc", 0))
+        t = float(e["t"])
+        if kind == "chunk":
+            build = float(e.get("build_s", 0.0) or 0.0)
+            ex = float(e.get("exec_s", 0.0) or 0.0)
+            start = t - ex - build
+            args = _args(e)
+            trace.append({"ph": "X", "pid": p, "tid": _TID_DRIVER,
+                          "cat": "chunk",
+                          "name": f"chunk {e.get('chunk')}",
+                          "ts": us(start), "dur": (build + ex) * 1e6,
+                          "args": args})
+            if build > 0:
+                trace.append({"ph": "X", "pid": p, "tid": _TID_DRIVER,
+                              "cat": "chunk", "name": "build",
+                              "ts": us(start), "dur": build * 1e6})
+            if ex > 0:
+                trace.append({"ph": "X", "pid": p, "tid": _TID_DRIVER,
+                              "cat": "chunk", "name": "exec",
+                              "ts": us(t - ex), "dur": ex * 1e6})
+        elif kind in ("checkpoint_save", "checkpoint_restore"):
+            dur = float(e.get("dur_s", 0.0) or 0.0)
+            trace.append({"ph": "X", "pid": p, "tid": _TID_DRIVER,
+                          "cat": "checkpoint",
+                          "name": e.get("op", kind),
+                          "ts": us(t - dur), "dur": dur * 1e6,
+                          "args": _args(e)})
+        elif kind == "snapshot_write":
+            dur = float(e.get("dur_s", 0.0) or 0.0)
+            trace.append({"ph": "X", "pid": p, "tid": _TID_IO,
+                          "cat": "io",
+                          "name": f"snapshot step {e.get('step')}",
+                          "ts": us(t - dur), "dur": dur * 1e6,
+                          "args": _args(e)})
+            if e.get("queue_depth") is not None:
+                trace.append({"ph": "C", "pid": p,
+                              "name": "igg_io_queue_depth", "ts": us(t),
+                              "args": {"depth": e["queue_depth"]}})
+        elif kind in _INSTANTS:
+            trace.append({"ph": "i", "pid": p, "tid": _TID_DRIVER,
+                          "cat": "event", "name": kind, "ts": us(t),
+                          "s": "p", "args": _args(e)})
+            if kind == "snapshot_drop" \
+                    and e.get("queue_depth") is not None:
+                trace.append({"ph": "C", "pid": p,
+                              "name": "igg_io_queue_depth", "ts": us(t),
+                              "args": {"depth": e["queue_depth"]}})
+        elif kind == "halo_exchange":
+            wire_cum[p] += int(e.get("wire_bytes", 0) or 0)
+            trace.append({"ph": "C", "pid": p,
+                          "name": "igg_halo_wire_bytes_total",
+                          "ts": us(t), "args": {"bytes": wire_cum[p]}})
+        elif kind in ("run_begin", "run_end", "snapshot", "reducers",
+                      "snapshot_writer_close"):
+            trace.append({"ph": "i", "pid": p, "tid": _TID_DRIVER,
+                          "cat": "run", "name": kind, "ts": us(t),
+                          "s": "t", "args": _args(e)})
+
+    doc = {
+        "traceEvents": trace,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "implicitglobalgrid_tpu flight recorder",
+            "processes": procs,
+        },
+    }
+    if agg is not None:
+        doc["otherData"]["run_id"] = agg.get("run_id")
+        doc["otherData"]["offsets"] = {
+            str(k): v for k, v in (agg.get("offsets") or {}).items()}
+        doc["otherData"]["align"] = agg.get("align")
+    if out is None:
+        return doc
+    out = os.fspath(out)
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    return out

@@ -229,6 +229,19 @@ def _cli(argv=None) -> int:
       time-series: ``step value`` lines) or from a single snapshot
       directory; O(one shard block) per snapshot via
       `io.Snapshot.read_point`, never the global array.
+    - ``aggregate <dir|files...>`` — merge per-process flight streams
+      (`telemetry.aggregate_flight`): prints the alignment summary
+      (processes, clock offsets, per-process event/chunk counts);
+      ``--out merged.jsonl`` additionally writes the merged, clock-
+      corrected event sequence as one JSONL.
+    - ``trace <dir|files...> [-o trace.json]`` — export the merged
+      stream as Chrome/Perfetto trace-event JSON
+      (`telemetry.export_chrome_trace`); open at
+      https://ui.perfetto.dev.
+    - ``stragglers <dir|files...>`` — the cross-process straggler &
+      imbalance report (`telemetry.straggler_report`): per-chunk
+      barrier-arrival spreads, slowest-process attribution, persistent-
+      straggler flags, wait/compute imbalance.
     """
     import argparse
     import json
@@ -266,9 +279,71 @@ def _cli(argv=None) -> int:
     pp.add_argument("index", nargs="+", type=int,
                     help="implicit-global cell index (one per dimension)")
     pp.add_argument("--json", action="store_true")
+    agp = sub.add_parser(
+        "aggregate", help="merge per-process flight streams into one "
+                          "clock-aligned mesh-wide sequence")
+    agp.add_argument("src", nargs="+",
+                     help="directory of flight_p*.jsonl streams, or the "
+                          "stream files themselves")
+    agp.add_argument("--run-id", default=None)
+    agp.add_argument("--out", default=None,
+                     help="also write the merged event sequence as JSONL")
+    agp.add_argument("--indent", type=int, default=2)
+    tp = sub.add_parser(
+        "trace", help="Chrome/Perfetto trace-event JSON from per-process "
+                      "flight streams (open at ui.perfetto.dev)")
+    tp.add_argument("src", nargs="+",
+                    help="directory of flight_p*.jsonl streams, or the "
+                         "stream files themselves")
+    tp.add_argument("-o", "--out", default="trace.json")
+    tp.add_argument("--run-id", default=None)
+    stp = sub.add_parser(
+        "stragglers", help="cross-process straggler & imbalance report")
+    stp.add_argument("src", nargs="+",
+                     help="directory of flight_p*.jsonl streams, or the "
+                          "stream files themselves")
+    stp.add_argument("--run-id", default=None)
+    stp.add_argument("--window", type=int, default=8,
+                     help="rolling window (chunks) for persistent-"
+                          "straggler flags")
+    stp.add_argument("--share", type=float, default=0.5,
+                     help="slowest-share above which a window flags")
+    stp.add_argument("--indent", type=int, default=2)
     args = ap.parse_args(argv)
 
     from .telemetry import prometheus_snapshot, run_report
+
+    def _agg_source():
+        return args.src[0] if len(args.src) == 1 else args.src
+
+    if args.cmd == "aggregate":
+        from .telemetry import aggregate_flight
+
+        agg = aggregate_flight(_agg_source(), run_id=args.run_id)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                for e in agg["events"]:
+                    f.write(json.dumps(e, default=str) + "\n")
+        summary = {k: v for k, v in agg.items() if k != "events"}
+        summary["events"] = len(agg["events"])
+        if args.out:
+            summary["out"] = args.out
+        print(json.dumps(summary, indent=args.indent, default=str))
+        return 0
+    if args.cmd == "trace":
+        from .telemetry import export_chrome_trace
+
+        path = export_chrome_trace(_agg_source(), args.out,
+                                   run_id=args.run_id)
+        print(path)
+        return 0
+    if args.cmd == "stragglers":
+        from .telemetry import aggregate_flight, straggler_report
+
+        agg = aggregate_flight(_agg_source(), run_id=args.run_id)
+        rep = straggler_report(agg, window=args.window, share=args.share)
+        print(json.dumps(rep, indent=args.indent, default=str))
+        return 0
 
     if args.cmd == "prom":
         sys.stdout.write(prometheus_snapshot())

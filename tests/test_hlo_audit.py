@@ -472,12 +472,15 @@ def test_guarded_runner_adds_exactly_one_small_allreduce():
 
 
 def test_telemetry_leaves_chunk_program_untouched(tmp_path):
-    """THE observability wire claim (ISSUE 3): telemetry is host-side
-    only — building the guarded chunk runner with an ACTIVE flight
-    recorder (and live metrics registry) yields a program with identical
+    """THE observability wire claim (ISSUE 3 + the ISSUE 5 mesh layer):
+    telemetry is host-side only — building the guarded chunk runner with
+    an ACTIVE flight recorder, live metrics registry, RUNNING metrics
+    server, and fresh driver heartbeats yields a program with identical
     collective counts and an identical fetch surface (same output arity,
-    same parameter count) as with telemetry off. Zero extra collectives,
-    zero extra D2H fetches per chunk."""
+    same parameter count) as with everything off. Zero extra collectives,
+    zero extra D2H fetches per chunk (cross-process aggregation is pure
+    post-hoc host arithmetic over the JSONLs — nothing to audit in the
+    program; the heartbeat/server are the only RUN-time additions)."""
     import re as _re
 
     from implicitglobalgrid_tpu.models import (
@@ -485,7 +488,8 @@ def test_telemetry_leaves_chunk_program_untouched(tmp_path):
     )
     from implicitglobalgrid_tpu.runtime.health import make_guarded_runner
     from implicitglobalgrid_tpu.telemetry import (
-        start_flight_recorder, stop_flight_recorder,
+        note_heartbeat, start_flight_recorder, start_metrics_server,
+        stop_flight_recorder, stop_metrics_server,
     )
 
     igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2,
@@ -498,11 +502,15 @@ def test_telemetry_leaves_chunk_program_untouched(tmp_path):
     off = make_guarded_runner(step, (3, 3), nt_chunk=4, key="hlo_tel_off")
     hlo_off = off.lower(T, Cp).compile().as_text()
     start_flight_recorder(str(tmp_path / "fr.jsonl"))
+    start_metrics_server(0)
     try:
+        note_heartbeat(0)
         on = make_guarded_runner(step, (3, 3), nt_chunk=4, key="hlo_tel_on")
         hlo_on = on.lower(T, Cp).compile().as_text()
         out_on = on(T, Cp)
+        note_heartbeat(4)
     finally:
+        stop_metrics_server()
         stop_flight_recorder()
     out_off = off(T, Cp)
 

@@ -1,0 +1,490 @@
+"""Mesh-wide observability tests (ISSUE 5): cross-process flight
+aggregation (clock-offset recovery at the chunk-boundary barriers,
+run-id/seq validation), the straggler & imbalance analyzer, Chrome/
+Perfetto trace export, the ``mesh`` section of `run_report`, the
+aggregate/trace/stragglers CLI, and the live metrics endpoint
+(`/metrics` + `/healthz`, driver heartbeat, `run_resilient(metrics_port)`).
+
+Cross-process streams are synthesized here with EXACT known skews (the
+one place ground truth exists); the true two-controller end-to-end run
+lives in tests/test_multiprocess.py."""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu import telemetry
+from implicitglobalgrid_tpu.utils.exceptions import InvalidArgumentError
+
+pytestmark = [pytest.mark.mesh, pytest.mark.telemetry]
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    igg.stop_flight_recorder()
+    igg.stop_metrics_server()
+    igg.reset_metrics()
+    yield
+    igg.stop_flight_recorder()
+    igg.stop_metrics_server()
+    igg.reset_metrics()
+
+
+# ---------------------------------------------------------------------------
+# Synthetic per-process streams with exact ground truth
+# ---------------------------------------------------------------------------
+
+def _write_stream(dirpath, proc, *, clock0, wall0, n_chunks=6,
+                  start_delay=0.0, compute=0.1, worst_delay=0.05,
+                  run_id="r1", drop_last_chunk=False, seq_start=0,
+                  extra=()):
+    """One process's flight JSONL with a barrier-consistent chunk
+    schedule: every chunk's TRUE barrier release is common to all
+    processes (the slowest arriver, delayed by ``worst_delay``, sets it);
+    this process dispatches ``start_delay`` after the boundary, so its
+    ``exec_s`` is the barrier release minus its own start. ``clock0`` is
+    the process's (arbitrary) monotonic origin, ``wall0`` its wall clock
+    at recorder open — aggregation must undo both."""
+    path = os.path.join(dirpath, f"flight_p{proc}.jsonl")
+    seq = seq_start
+    recs = []
+
+    def ev(kind, t, **kw):
+        nonlocal seq
+        recs.append({"t": t, "kind": kind, "run": run_id, "pid": 10 + proc,
+                     "proc": proc, "seq": seq, **kw})
+        seq += 1
+
+    t = clock0
+    ev("recorder_open", t, wall=wall0, version=1)
+    ev("run_begin", t, nt=n_chunks * 10, nt_chunk=10, names=["T"],
+       checkpoint_every=10)
+    for c in range(n_chunks):
+        start = t + start_delay
+        t = t + worst_delay + compute          # the mesh barrier release
+        if drop_last_chunk and c == n_chunks - 1:
+            continue
+        ev("chunk", t, chunk=c, step_begin=c * 10, step_end=(c + 1) * 10,
+           n=10, ok=True, reasons=[], build_s=0.004, exec_s=t - start)
+    for kind, kw in extra:
+        ev(kind, t, **kw)
+    ev("run_end", t, completed=n_chunks * 10, chunks=n_chunks)
+    ev("recorder_close", t)
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return path
+
+
+def _two_proc_dir(tmp_path, **kw):
+    d = str(tmp_path / "flights")
+    os.makedirs(d, exist_ok=True)
+    # proc 1 is the straggler: it dispatches 0.05s late every boundary;
+    # its monotonic clock origin and wall clock are wildly/slightly off
+    _write_stream(d, 0, clock0=1000.0, wall0=5000.0, **kw)
+    _write_stream(d, 1, clock0=987654.0, wall0=5000.25,
+                  start_delay=0.05, **kw)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# aggregate_flight
+# ---------------------------------------------------------------------------
+
+def test_aggregate_recovers_offsets_and_merges(tmp_path):
+    d = _two_proc_dir(tmp_path)
+    agg = igg.aggregate_flight(d)
+    assert agg["run_id"] == "r1"
+    assert agg["processes"] == [0, 1] and agg["anchor_proc"] == 0
+    assert agg["align"]["method"] == {0: "anchor", 1: "chunk-barrier"}
+    # both processes stamp the SAME physical barrier instants, so after
+    # wall anchoring the residual offset is exactly the wall skew (0.25s)
+    assert agg["offsets"][0] == 0.0
+    assert abs(agg["offsets"][1] - 0.25) < 1e-6
+    assert agg["align"]["residual_s"][1] < 1e-9
+    assert agg["align"]["chunks_used"][1] == 6
+    # merged events are time-sorted on ONE corrected clock; each chunk's
+    # two per-process records land at the same corrected barrier time
+    evs = agg["events"]
+    ts = [e["t"] for e in evs if "t" in e]
+    assert ts == sorted(ts)
+    for c in range(6):
+        pair = [e for e in evs if e.get("kind") == "chunk"
+                and e.get("chunk") == c]
+        assert len(pair) == 2
+        assert abs(pair[0]["t"] - pair[1]["t"]) < 1e-6
+    assert all("t_mono" in e and "t_offset" in e for e in evs)
+    meta = agg["per_process"]
+    assert meta[0]["chunks"] == meta[1]["chunks"] == 6
+
+
+def test_aggregate_accepts_explicit_paths_and_single_file(tmp_path):
+    d = _two_proc_dir(tmp_path)
+    paths = sorted(os.path.join(d, f) for f in os.listdir(d))
+    agg = igg.aggregate_flight(paths)
+    assert agg["processes"] == [0, 1]
+    # single-process stream: aggregation degenerates gracefully
+    one = igg.aggregate_flight(paths[0])
+    assert one["processes"] == [0] and one["offsets"] == {0: 0.0}
+
+
+def test_aggregate_validation_errors(tmp_path):
+    d = str(tmp_path / "bad")
+    os.makedirs(d)
+    with pytest.raises(InvalidArgumentError, match="no .*jsonl"):
+        igg.aggregate_flight(d)
+    _write_stream(d, 0, clock0=0.0, wall0=100.0)
+    _write_stream(d, 1, clock0=0.0, wall0=100.0, run_id="OTHER")
+    # two run ids without an explicit choice must never silently mix
+    with pytest.raises(InvalidArgumentError, match="run ids"):
+        igg.aggregate_flight(d)
+    agg = igg.aggregate_flight(d, run_id="OTHER")
+    assert agg["processes"] == [1]
+    with pytest.raises(InvalidArgumentError, match="no events"):
+        igg.aggregate_flight(d, run_id="nope")
+    # a seq gap (stream truncated mid-run / file missing) is detected
+    gap = str(tmp_path / "gap")
+    os.makedirs(gap)
+    p = _write_stream(gap, 0, clock0=0.0, wall0=100.0)
+    lines = open(p).read().splitlines()
+    open(p, "w").write("\n".join(lines[:3] + lines[4:]) + "\n")
+    with pytest.raises(InvalidArgumentError, match="gaps"):
+        igg.aggregate_flight(gap)
+    # duplicate seqs (two writers interleaved one file) are detected
+    dup = str(tmp_path / "dup")
+    os.makedirs(dup)
+    p = _write_stream(dup, 0, clock0=0.0, wall0=100.0)
+    first = open(p).read().splitlines()
+    open(p, "a").write(first[1] + "\n")
+    with pytest.raises(InvalidArgumentError, match="duplicate"):
+        igg.aggregate_flight(dup)
+    # a head-truncated stream (lost recorder_open wall anchor) is refused,
+    # not silently mis-aligned
+    head = str(tmp_path / "head")
+    os.makedirs(head)
+    p = _write_stream(head, 0, clock0=0.0, wall0=100.0)
+    lines = open(p).read().splitlines()
+    open(p, "w").write("\n".join(lines[3:]) + "\n")
+    with pytest.raises(InvalidArgumentError, match="start at 0"):
+        igg.aggregate_flight(head)
+
+
+def test_run_report_aligns_preloaded_multiprocess_events(tmp_path):
+    """A multi-process stream passed as an EVENT LIST (not a directory)
+    must go through the same clock alignment — raw monotonic stamps are
+    not comparable across hosts, and a straggler verdict on them would be
+    silently wrong."""
+    d = _two_proc_dir(tmp_path)
+    events = []
+    for f in sorted(os.listdir(d)):
+        events.extend(igg.read_flight_events(os.path.join(d, f)))
+    rep = igg.run_report(events, include_metrics=False)
+    assert rep["mesh"]["summary"]["worst_proc"] == 1
+    assert abs(rep["mesh"]["offsets"][1] - 0.25) < 1e-6
+    assert rep["chunks"]["count"] == 6
+    # and aggregate_events is the public path to the same alignment
+    agg = igg.aggregate_events(events)
+    assert abs(agg["offsets"][1] - 0.25) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# straggler_report
+# ---------------------------------------------------------------------------
+
+def test_straggler_attribution_and_imbalance(tmp_path):
+    d = _two_proc_dir(tmp_path)
+    agg = igg.aggregate_flight(d)
+    rep = igg.straggler_report(agg, window=4)
+    assert rep["processes"] == [0, 1]
+    # proc 1 dispatches 0.05s late at every boundary: it is the slowest
+    # arriver on every chunk, the spread IS the injected delay, and all
+    # the barrier wait lands on proc 0
+    assert rep["slowest_counts"] == {0: 0, 1: 6}
+    assert rep["summary"]["worst_proc"] == 1
+    assert abs(rep["summary"]["spread_s_mean"] - 0.05) < 1e-6
+    for ch in rep["chunks"]:
+        assert ch["slowest"] == 1
+        assert abs(ch["spread_s"] - 0.05) < 1e-6
+        assert abs(ch["arrival_s"][1] - 0.05) < 1e-6
+        assert ch["arrival_s"][0] == 0.0
+        assert abs(ch["compute_s"] - 0.1) < 1e-6
+    imb = rep["imbalance"]
+    assert imb[1]["wait_s_total"] < 1e-9          # straggler never waits
+    assert abs(imb[0]["wait_s_total"] - 6 * 0.05) < 1e-6
+    assert 0.3 < imb[0]["wait_frac"] < 0.4        # 0.05 / 0.15
+    # persistent: slowest in 100% of every rolling window -> ONE merged
+    # span whose chunks/share describe the whole span, not one window
+    assert rep["persistent"] == [{"proc": 1, "first_chunk": 0,
+                                  "last_chunk": 5, "chunks": 6,
+                                  "share": 1.0}]
+
+
+def test_straggler_needs_two_processes_and_common_chunks(tmp_path):
+    d = str(tmp_path / "one")
+    os.makedirs(d)
+    _write_stream(d, 0, clock0=0.0, wall0=100.0)
+    with pytest.raises(InvalidArgumentError, match="two"):
+        igg.straggler_report(igg.aggregate_flight(d))
+    # a chunk one process never logged is excluded, not mis-attributed
+    d2 = str(tmp_path / "partial")
+    os.makedirs(d2)
+    _write_stream(d2, 0, clock0=0.0, wall0=100.0)
+    _write_stream(d2, 1, clock0=0.0, wall0=100.0, start_delay=0.05,
+                  drop_last_chunk=True)
+    rep = igg.straggler_report(igg.aggregate_flight(d2))
+    assert rep["summary"]["chunks"] == 5
+    assert rep["slowest_counts"] == {0: 0, 1: 5}
+    # a process sharing NO chunk with the anchor falls back to its wall
+    # anchor alone — without degrading the aligned processes' metadata
+    d3 = str(tmp_path / "nocommon")
+    os.makedirs(d3)
+    _write_stream(d3, 0, clock0=0.0, wall0=100.0)
+    _write_stream(d3, 1, clock0=50.0, wall0=100.0, start_delay=0.05)
+    _write_stream(d3, 2, clock0=0.0, wall0=100.0, drop_last_chunk=True,
+                  n_chunks=1)  # its only chunk is dropped: none shared
+    agg3 = igg.aggregate_flight(d3)
+    assert agg3["align"]["method"] == {0: "anchor", 1: "chunk-barrier",
+                                       2: "wall-anchor"}
+    assert agg3["align"]["residual_s"][2] is None
+    assert agg3["align"]["residual_s"][1] is not None
+
+
+# ---------------------------------------------------------------------------
+# export_chrome_trace
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_structure_and_barrier_alignment(tmp_path):
+    d = _two_proc_dir(tmp_path, extra=[
+        ("guard_trip", {"step_end": 60, "reasons": ["nonfinite:T"],
+                        "retries": 1}),
+        ("checkpoint_save", {"op": "save_sharded", "step": 60,
+                             "dur_s": 0.02, "path": "x"}),
+        ("snapshot_write", {"step": 60, "dur_s": 0.01, "nbytes": 4096,
+                            "queue_depth": 1, "path": "y"}),
+        ("halo_exchange", {"fields": 1, "ppermutes": 6,
+                           "wire_bytes": 1234, "local_copy_bytes": 0}),
+    ])
+    out = str(tmp_path / "trace.json")
+    assert igg.export_chrome_trace(d, out) == out
+    doc = json.load(open(out))
+    evs = doc["traceEvents"]
+    assert doc["otherData"]["run_id"] == "r1"
+    assert doc["otherData"]["processes"] == [0, 1]
+    # one named track per process
+    names = {(e["pid"], e["args"]["name"]) for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {(0, "igg process 0"), (1, "igg process 1")}
+    # chunk spans exist per process and END barrier-aligned across them
+    for c in range(6):
+        spans = [e for e in evs if e.get("ph") == "X"
+                 and e["name"] == f"chunk {c}"]
+        assert len(spans) == 2 and {s["pid"] for s in spans} == {0, 1}
+        ends = [s["ts"] + s["dur"] for s in spans]
+        assert abs(ends[0] - ends[1]) < 5  # microseconds
+        for s in spans:
+            assert s["ts"] >= 0 and s["dur"] > 0
+    # nested build/exec phases, checkpoint + snapshot spans on their tracks
+    assert any(e.get("ph") == "X" and e["name"] == "exec" for e in evs)
+    ck = next(e for e in evs if e.get("ph") == "X"
+              and e["name"] == "save_sharded")
+    assert ck["cat"] == "checkpoint" and ck["dur"] == pytest.approx(2e4)
+    snap = next(e for e in evs if e.get("ph") == "X"
+                and e["cat"] == "io")
+    assert snap["tid"] != ck["tid"]  # io writer has its own thread track
+    # instants and counter samples
+    assert any(e.get("ph") == "i" and e["name"] == "guard_trip"
+               for e in evs)
+    depth = [e for e in evs if e.get("ph") == "C"
+             and e["name"] == "igg_io_queue_depth"]
+    assert depth and depth[0]["args"]["depth"] == 1
+    wire = [e for e in evs if e.get("ph") == "C"
+            and e["name"] == "igg_halo_wire_bytes_total"]
+    assert wire and wire[-1]["args"]["bytes"] == 1234
+    # returns the dict (no file) when out is omitted
+    doc2 = igg.export_chrome_trace(igg.aggregate_flight(d))
+    assert len(doc2["traceEvents"]) == len(evs)
+
+
+def test_chrome_trace_aligns_single_file_and_event_list(tmp_path):
+    """A multi-process stream arriving as ONE concatenated file (or a
+    pre-loaded event list) must be clock-aligned exactly like a
+    directory — a Perfetto timeline on raw per-process monotonic clocks
+    would look authoritative and be silently uncorrelatable."""
+    d = _two_proc_dir(tmp_path)
+    cat = str(tmp_path / "all.jsonl")
+    with open(cat, "w") as out:
+        for f in sorted(os.listdir(d)):
+            out.write(open(os.path.join(d, f)).read())
+    for source in (cat, igg.read_flight_events(cat)):
+        doc = igg.export_chrome_trace(source)
+        assert doc["otherData"]["align"]["method"][1] == "chunk-barrier"
+        for c in range(6):
+            ends = [e["ts"] + e["dur"] for e in doc["traceEvents"]
+                    if e.get("ph") == "X" and e["name"] == f"chunk {c}"]
+            assert len(ends) == 2 and abs(ends[0] - ends[1]) < 5  # µs
+
+
+# ---------------------------------------------------------------------------
+# run_report: the "mesh" section
+# ---------------------------------------------------------------------------
+
+def test_run_report_mesh_section_from_directory(tmp_path):
+    d = _two_proc_dir(tmp_path)
+    rep = igg.run_report(d, include_metrics=False)
+    assert rep["run_id"] == "r1"
+    mesh = rep["mesh"]
+    assert mesh["processes"] == [0, 1]
+    assert mesh["summary"]["worst_proc"] == 1
+    assert abs(mesh["offsets"][1] - 0.25) < 1e-6
+    assert mesh["persistent_stragglers"][0]["proc"] == 1
+    # the per-run sections reconstruct the ANCHOR process's view — chunk
+    # counts are per process, not multiplied by the process count
+    assert rep["chunks"]["count"] == 6
+    kinds = [e["kind"] for e in rep["sequence"]]
+    assert kinds.count("run_begin") == 1 and kinds.count("run_end") == 1
+    # single-process report stays mesh-free
+    rep1 = igg.run_report(os.path.join(d, "flight_p0.jsonl"),
+                          include_metrics=False)
+    assert "mesh" not in rep1
+
+
+# ---------------------------------------------------------------------------
+# CLI: aggregate | trace | stragglers
+# ---------------------------------------------------------------------------
+
+def test_mesh_cli_subcommands(tmp_path, capsys):
+    from implicitglobalgrid_tpu.tools import _cli
+
+    d = _two_proc_dir(tmp_path)
+    merged = str(tmp_path / "merged.jsonl")
+    assert _cli(["aggregate", d, "--out", merged]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["processes"] == [0, 1] and summary["out"] == merged
+    assert summary["events"] > 0 and "offsets" in summary
+    n_lines = sum(1 for _ in open(merged))
+    assert n_lines == summary["events"]
+
+    out = str(tmp_path / "t.json")
+    assert _cli(["trace", d, "-o", out]) == 0
+    assert capsys.readouterr().out.strip() == out
+    assert json.load(open(out))["traceEvents"]
+
+    assert _cli(["stragglers", d, "--window", "4"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["summary"]["worst_proc"] == 1
+    assert rep["slowest_counts"] == {"0": 0, "1": 6}
+
+
+# ---------------------------------------------------------------------------
+# Live metrics endpoint
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode(), r.headers
+
+
+def test_metrics_server_serves_prometheus_and_healthz():
+    igg.metrics_registry().counter("mesh_test_total", "t").inc(3)
+    srv = igg.start_metrics_server(0)  # ephemeral port
+    try:
+        assert igg.metrics_server() is srv and srv.port > 0
+        status, body, headers = _get(
+            f"http://127.0.0.1:{srv.port}/metrics")
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        assert "# TYPE mesh_test_total counter" in body
+        assert "mesh_test_total 3" in body
+        # healthz before any heartbeat: alive, age unknown
+        status, body, _ = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        rec = json.loads(body)
+        assert status == 200 and rec["ok"] is True
+        assert rec["heartbeat_age_s"] is None
+        telemetry.note_heartbeat(70)
+        _, body, _ = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        rec = json.loads(body)
+        assert rec["step"] == 70 and 0 <= rec["heartbeat_age_s"] < 60
+        status, _, _ = _get(f"http://127.0.0.1:{srv.port}/metrics")
+        assert status == 200  # snapshot includes the heartbeat gauges now
+        # a second server without stopping the first is refused
+        with pytest.raises(InvalidArgumentError, match="already running"):
+            igg.start_metrics_server(0)
+    finally:
+        igg.stop_metrics_server()
+    assert igg.metrics_server() is None
+    igg.stop_metrics_server()  # idempotent
+
+
+def test_healthz_stale_heartbeat_returns_503():
+    from implicitglobalgrid_tpu.telemetry.hooks import HEARTBEAT_TS
+
+    srv = igg.start_metrics_server(0, healthz_max_age_s=2.0)
+    try:
+        # no heartbeat at all -> not ok under a max age
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert exc.value.code == 503
+        telemetry.note_heartbeat(1)
+        status, body, _ = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert status == 200 and json.loads(body)["ok"] is True
+        # stamp an OLD heartbeat directly: stale -> 503 again
+        import time as _time
+
+        igg.metrics_registry().gauge(HEARTBEAT_TS, "").set(
+            _time.time() - 5.0)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read().decode())["ok"] is False
+    finally:
+        igg.stop_metrics_server()
+
+
+def test_run_resilient_metrics_port_serves_during_run(tmp_path):
+    """`run_resilient(metrics_port=0)`: the endpoint is LIVE during the
+    run (scraped from an on_report callback — a real mid-run Prometheus
+    exposition with the driver heartbeat), and torn down afterwards."""
+    from implicitglobalgrid_tpu.models import (
+        diffusion_step_local, init_diffusion3d,
+    )
+
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=1, quiet=True)
+    T, Cp, p = init_diffusion3d(dtype=np.float64)
+
+    def step(s):
+        return {"T": diffusion_step_local(s["T"], s["Cp"], p, "xla"),
+                "Cp": s["Cp"]}
+
+    scraped = []
+
+    def on_report(rep):
+        srv = igg.metrics_server()
+        assert srv is not None
+        assert srv.healthz_max_age_s == 120.0  # forwarded to /healthz
+        _, metrics, _ = _get(f"http://127.0.0.1:{srv.port}/metrics")
+        _, health, _ = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        scraped.append((metrics, json.loads(health)))
+
+    with pytest.raises(InvalidArgumentError, match="metrics_port"):
+        igg.run_resilient(step, {"T": T, "Cp": Cp}, 6, nt_chunk=2,
+                          key="mesh_srv", healthz_max_age_s=120.0)
+    igg.run_resilient(step, {"T": T, "Cp": Cp}, 6, nt_chunk=2,
+                      key="mesh_srv", on_report=on_report, metrics_port=0,
+                      healthz_max_age_s=120.0)
+    assert len(scraped) == 3
+    metrics, health = scraped[-1]
+    assert "igg_driver_heartbeat_timestamp_seconds" in metrics
+    assert "igg_health_events_total" in metrics
+    assert health["heartbeat_age_s"] is not None
+    assert health["step"] == 4.0  # last COMMITTED step at the final chunk
+    assert igg.metrics_server() is None  # torn down with the run
+    # the run's boundary heartbeats landed in the gauges
+    from implicitglobalgrid_tpu.telemetry.hooks import HEARTBEAT_STEP
+
+    assert igg.metrics_registry().get(HEARTBEAT_STEP).value() == 6

@@ -176,6 +176,54 @@ _CHILD_DEEP = textwrap.dedent("""
 """)
 
 
+_CHILD_FLIGHT = textwrap.dedent("""
+    import os, sys, time
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    ndev = int(sys.argv[5]) if len(sys.argv) > 5 else 4
+    flight_dir = os.path.join(
+        os.path.dirname(os.path.abspath(sys.argv[0])), "flights")
+    os.makedirs(flight_dir, exist_ok=True)
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={ndev}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nproc, process_id=pid)
+    import numpy as np
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import (
+        diffusion_step_local, init_diffusion3d,
+    )
+
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1,
+                         quiet=True, init_dist=False, reorder=0)
+    T, Cp, p = init_diffusion3d(dtype=np.float32)
+
+    def step(s):
+        return {"T": diffusion_step_local(s["T"], s["Cp"], p, "xla"),
+                "Cp": s["Cp"]}
+
+    # the directory convention: every process writes flight_p<i>.jsonl
+    igg.start_flight_recorder(flight_dir, run_id="mpflight")
+    assert os.path.basename(igg.flight_recorder().path) \\
+        == f"flight_p{pid}.jsonl"
+
+    # the straggler poke: process 1 stalls HOST-side at every chunk
+    # boundary (on_report runs between chunks) — the aggregated analysis
+    # must attribute exactly this process
+    def on_report(rep):
+        if pid == 1:
+            time.sleep(0.25)
+
+    igg.run_resilient(step, {"T": T, "Cp": Cp}, 30, nt_chunk=5,
+                      key="mp_flight", on_report=on_report)
+    igg.stop_flight_recorder()
+    igg.finalize_global_grid()
+    print(f"MP_OK {pid}", flush=True)
+""")
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -232,6 +280,59 @@ def test_two_process_deep_halo_bitwise(tmp_path):
     ppermutes cross the controller split, and the trajectory must still be
     bit-identical to exchange-every-step on the same implicit grid."""
     _run_children(tmp_path, 2, "", 4, timeout=300, child=_CHILD_DEEP)
+
+
+@pytest.mark.mesh
+def test_two_process_flight_aggregation_names_the_straggler(tmp_path):
+    """Mesh-wide observability end-to-end (ISSUE 5): two REAL controllers
+    run a supervised diffusion under per-process flight recorders (the
+    ``flight_p<i>.jsonl`` directory convention), process 1 stalls
+    host-side at every chunk boundary, and the post-hoc aggregation must
+    (a) merge into one run-id-consistent sequence with matching per-
+    process chunk counts, (b) attribute the injected delay to process 1,
+    and (c) export a two-track Chrome trace with barrier-aligned chunk
+    spans."""
+    import implicitglobalgrid_tpu as igg
+
+    _run_children(tmp_path, 2, "", 4, timeout=300, child=_CHILD_FLIGHT)
+    d = str(tmp_path / "flights")
+    assert sorted(os.listdir(d)) == ["flight_p0.jsonl", "flight_p1.jsonl"]
+
+    agg = igg.aggregate_flight(d)
+    assert agg["run_id"] == "mpflight"
+    assert agg["processes"] == [0, 1]
+    assert agg["align"]["method"][1] == "chunk-barrier"
+    assert agg["per_process"][0]["chunks"] == agg["per_process"][1]["chunks"] == 6
+    seqs = {e["seq"] for e in agg["events"] if e["proc"] == 0}
+    assert seqs == set(range(len(seqs)))  # gapless, validated
+
+    rep = igg.straggler_report(agg, window=4)
+    # process 1 slept 0.25s at 5 of 6 boundaries (none after the last
+    # chunk's report): it must dominate the slowest attribution and the
+    # mean spread must resolve the injected stall (compute per chunk is
+    # far smaller on this toy grid)
+    assert rep["summary"]["worst_proc"] == 1
+    assert rep["slowest_counts"][1] >= 4
+    assert rep["summary"]["spread_s_max"] > 0.1
+    assert rep["imbalance"][0]["wait_s_total"] \
+        > rep["imbalance"][1]["wait_s_total"]
+    assert rep["persistent"] and rep["persistent"][0]["proc"] == 1
+
+    # the unified report over the directory carries the mesh section
+    report = igg.run_report(d, include_metrics=False)
+    assert report["mesh"]["summary"]["worst_proc"] == 1
+    assert report["chunks"]["count"] == 6  # anchor process's view
+
+    # Perfetto export: two tracks, chunk spans end barrier-aligned
+    doc = igg.export_chrome_trace(agg)
+    pids = {e["pid"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert pids == {0, 1}
+    for c in (1, 3, 5):
+        ends = sorted(e["ts"] + e["dur"] for e in doc["traceEvents"]
+                      if e.get("ph") == "X" and e["name"] == f"chunk {c}")
+        assert len(ends) == 2
+        # aligned to well under the injected 250 ms skew (fetch jitter)
+        assert ends[1] - ends[0] < 100e3  # µs
 
 
 def test_four_process_two_dcn_axes(tmp_path):

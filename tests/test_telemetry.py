@@ -205,6 +205,51 @@ def test_prometheus_snapshot_parses_with_escaped_labels():
     assert abs(hist["lat_seconds_sum"] - 0.3) < 1e-9
 
 
+def test_prometheus_snapshot_golden_label_escaping():
+    """Exposition-format edge cases locked against GOLDEN strings: label
+    values containing ``"`` / newline / backslash must escape exactly as
+    the format spec says (backslash first — a quote escaped after a
+    backslash double-escapes)."""
+    reg = MetricsRegistry()
+    c = reg.counter("edge_total", "h", ("v",))
+    c.inc(1, v='quote"end')
+    c.inc(2, v="line\nbreak")
+    c.inc(3, v="back\\slash")
+    c.inc(4, v='all\\"of\nit')
+    text = telemetry.prometheus_snapshot(reg)
+    assert 'edge_total{v="quote\\"end"} 1' in text
+    assert 'edge_total{v="line\\nbreak"} 2' in text
+    assert 'edge_total{v="back\\\\slash"} 3' in text
+    assert 'edge_total{v="all\\\\\\"of\\nit"} 4' in text
+
+
+def test_prometheus_snapshot_golden_inf_nan_gauges():
+    """±Inf and NaN gauge samples render as the spec's literal tokens
+    (``+Inf`` / ``-Inf`` / ``NaN``), never as python's ``inf``/``nan``."""
+    reg = MetricsRegistry()
+    g = reg.gauge("extreme", "h", ("which",))
+    g.set(float("inf"), which="pos")
+    g.set(float("-inf"), which="neg")
+    g.set(float("nan"), which="nan")
+    g.set(-0.0, which="negzero")
+    text = telemetry.prometheus_snapshot(reg)
+    assert 'extreme{which="pos"} +Inf' in text
+    assert 'extreme{which="neg"} -Inf' in text
+    assert 'extreme{which="nan"} NaN' in text
+    assert 'extreme{which="negzero"} 0' in text
+    assert "inf\n" not in text and "nan\n" not in text
+
+
+def test_prometheus_snapshot_empty_registry_golden():
+    reg = MetricsRegistry()
+    assert telemetry.prometheus_snapshot(reg) == ""
+    # a registered family with no series still exposes HELP/TYPE
+    reg.counter("lonely_total", "no samples yet")
+    assert telemetry.prometheus_snapshot(reg) == (
+        "# HELP lonely_total no samples yet\n"
+        "# TYPE lonely_total counter\n")
+
+
 # ---------------------------------------------------------------------------
 # Flight recorder
 # ---------------------------------------------------------------------------
@@ -296,10 +341,14 @@ def test_recorder_thread_safety(tmp_path):
 
 
 def test_recorder_into_directory_and_multi_run_filter(tmp_path):
+    """A directory path follows the per-process convention
+    (``flight_p<process_index>.jsonl``) so N controllers sharing one
+    directory never interleave one file — the layout `aggregate_flight`
+    globs (single-process tests run as process 0)."""
     igg.start_flight_recorder(str(tmp_path), run_id="runA")
     igg.record_event("a")
     path = igg.stop_flight_recorder()
-    assert os.path.basename(path) == "igg_run_runA.jsonl"
+    assert os.path.basename(path) == "flight_p0.jsonl"
     # second run appended into the SAME file still separates by run id
     igg.start_flight_recorder(path, run_id="runB")
     igg.record_event("b")
@@ -454,6 +503,41 @@ def test_run_report_merges_trace_and_metrics(tmp_path):
     names = {fam["name"] for fam in rep["metrics"]}
     assert "igg_health_events_total" in names
     assert "igg_runner_cache_total" in names
+
+
+def test_run_report_sequence_carries_snapshot_writer_close(tmp_path):
+    """Regression: the driver emits ``snapshot_writer_close`` with the
+    writer's drain stats on every exit path, but the kind was missing
+    from `_SEQ_FIELDS` — the stats silently vanished from the
+    reconstructed sequence. They must survive, fields included."""
+    igg.start_flight_recorder(str(tmp_path / "run.jsonl"), run_id="wc")
+    igg.record_event("run_begin", nt=10, nt_chunk=5, names=["T"])
+    igg.record_event("chunk", chunk=0, step_begin=0, step_end=10, ok=True,
+                     reasons=[], build_s=0.01, exec_s=0.1)
+    igg.record_event("snapshot_writer_close", submitted=3, written=2,
+                     staged=0, dropped=1, errors=0, bytes=4096)
+    igg.record_event("run_end", completed=10, chunks=1)
+    path = igg.stop_flight_recorder()
+    rep = igg.run_report(path, include_metrics=False)
+    kinds = [e["kind"] for e in rep["sequence"]]
+    assert "snapshot_writer_close" in kinds
+    close = next(e for e in rep["sequence"]
+                 if e["kind"] == "snapshot_writer_close")
+    assert close == {"kind": "snapshot_writer_close", "t": close["t"],
+                     "submitted": 3, "written": 2, "staged": 0,
+                     "dropped": 1, "errors": 0, "bytes": 4096}
+    # end-to-end: a real snapshotting run's drain stats reach the sequence
+    _init()
+    step, state = _diffusion_step()
+    igg.start_flight_recorder(str(tmp_path / "run2.jsonl"), run_id="wc2")
+    igg.run_resilient(step, state, 4, nt_chunk=2, key="tel_wc",
+                      snapshot_dir=str(tmp_path / "snaps"))
+    rep2 = igg.run_report(igg.stop_flight_recorder(),
+                          include_metrics=False)
+    close2 = [e for e in rep2["sequence"]
+              if e["kind"] == "snapshot_writer_close"]
+    assert len(close2) == 1 and close2[0]["written"] == 2
+    assert close2[0]["submitted"] == 2 and "bytes" in close2[0]
 
 
 def test_report_cli_subprocess(tmp_path):
