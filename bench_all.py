@@ -172,6 +172,19 @@ def main() -> None:
     for row in bench_io.run_io_overhead(dims3, cpu):
         results.append(bench_util.emit(row))
 
+    # --- performance oracle: drift-detector overhead + model fidelity ------
+    # the live PerfWatch's per-boundary cost (deterministic accounting,
+    # target < 2%) and the calibrated model's measured/modeled per-step
+    # ratio for the diffusion3D/acoustic3D configs with the roofline
+    # bound verdict and its repeat-calibration stability (ISSUE 6).
+    # Config owned by `bench_perf.run_perf_overhead`/`run_model_ratio`.
+    import bench_perf
+
+    for row in bench_perf.run_perf_overhead(dims3, cpu):
+        results.append(bench_util.emit(row))
+    for row in bench_perf.run_model_ratio(dims3, cpu):
+        results.append(bench_util.emit(row))
+
     # --- pseudo-transient Stokes 3-D (BASELINE config 5) -------------------
     nxs, nts = (24, 20) if cpu else (128, 300)
     igg.init_global_grid(nxs, nxs, nxs, dimx=dims3[0], dimy=dims3[1],
@@ -184,8 +197,32 @@ def main() -> None:
            _rate(cells, nts, t) / n_chips, "cell-updates/s/chip")
     igg.finalize_global_grid()
 
+    # --- perf-history gate: the bench trajectory checks itself -------------
+    # current run vs the trailing PERF_HISTORY.jsonl window (checked
+    # BEFORE appending, so a run never gates against itself); the verdict
+    # rides BENCH_ALL.json as its own row. Exit-0-with-recorded-failure is
+    # the bench contract; IGG_BENCH_STRICT=1 turns a regression into rc=1.
+    import os
+
+    from implicitglobalgrid_tpu.telemetry import perfdb_add, perfdb_check
+
+    hist = "PERF_HISTORY.jsonl"
+    gate = perfdb_check(hist, results)
+    perfdb_add(hist, results)
+    results.append(bench_util.emit({
+        "metric": "perfdb_gate_ok",
+        "value": 1.0 if gate["ok"] else 0.0,
+        "unit": "bool (1 = no metric regressed vs the trailing window)",
+        "history_runs": gate["history_runs"],
+        "checked": gate["checked"],
+        "regressions": [r["metric"] for r in gate["regressions"]],
+        "improvements": [r["metric"] for r in gate["improvements"]],
+    }))
+
     with open("BENCH_ALL.json", "w") as f:
         json.dump(results, f, indent=1)
+    if not gate["ok"] and os.environ.get("IGG_BENCH_STRICT") == "1":
+        sys.exit(1)
 
 
 if __name__ == "__main__":

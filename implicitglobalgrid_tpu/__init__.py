@@ -73,6 +73,9 @@ from .telemetry import (
     export_chrome_trace,
     MetricsServer, start_metrics_server, stop_metrics_server,
     metrics_server,
+    MachineProfile, StepWorkload, PerfWatch, default_machine_profile,
+    load_machine_profile, save_machine_profile, predict_step,
+    calibrate_machine, perfdb_add, perfdb_check,
 )
 from . import io
 from .io import (
@@ -112,6 +115,12 @@ __all__ = [
     "export_chrome_trace",
     "MetricsServer", "start_metrics_server", "stop_metrics_server",
     "metrics_server",
+    # performance oracle (analytical cost model, calibration, drift
+    # detection, perf-history gate)
+    "MachineProfile", "StepWorkload", "PerfWatch",
+    "default_machine_profile", "load_machine_profile",
+    "save_machine_profile", "predict_step", "calibrate_machine",
+    "perfdb_add", "perfdb_check",
     # io (sharded snapshot & in-situ analysis pipeline)
     "io", "SnapshotWriter", "write_snapshot", "open_snapshot",
     "list_snapshots", "Probe", "AxisSlice", "Stats",

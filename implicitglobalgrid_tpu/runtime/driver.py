@@ -148,7 +148,9 @@ def run_resilient(step_local, state: dict, nt: int, *,
                   snapshot_policy: str = "block",
                   reducers=(), on_reduce=None,
                   metrics_port: int | None = None,
-                  healthz_max_age_s: float | None = None):
+                  healthz_max_age_s: float | None = None,
+                  perf_model=None, perf_window: int = 16,
+                  perf_zmax: float = 4.0):
     """Advance ``state`` by ``nt`` steps under health supervision with
     checkpoint-rollback recovery. Returns ``(state, reports)``.
 
@@ -195,7 +197,21 @@ def run_resilient(step_local, state: dict, nt: int, *,
     driver restart signal a supervisor's HTTP probe acts on; size it to
     a few chunk durations. Binds 127.0.0.1 — see the security note in
     docs/observability.md. The heartbeat gauges themselves are stamped
-    at every chunk boundary whether or not a server runs."""
+    at every chunk boundary whether or not a server runs.
+
+    Performance oracle (`telemetry.perfmodel`, host-side only): every
+    chunk boundary feeds the live drift detector — a rolling per-step
+    baseline (median + MAD over ``perf_window`` chunks); a chunk whose
+    robust z-score exceeds ``perf_zmax`` emits a ``perf_regression``
+    flight event and bumps ``igg_perf_regressions_total``, and the
+    ``igg_perf_*`` gauges (per-step seconds, model ratio, z-score) track
+    every boundary. Cold chunks (the dispatch after a runner-cache miss
+    pays the XLA compile) are exempt from both the test and the
+    baseline. ``perf_model`` attaches a prediction — a
+    `telemetry.predict_step` record or modeled per-step seconds — which
+    enables the measured/modeled ratio gauge and is echoed as a
+    ``perf_model`` flight event for `run_report`'s ``"perf"`` section;
+    ``perf_window=0`` disables the detector entirely."""
     import numpy as np
 
     from ..parallel.topology import check_initialized
@@ -241,9 +257,33 @@ def run_resilient(step_local, state: dict, nt: int, *,
                     f"{f.name!r} of stacked shape {tuple(shape)}.")
     # the live endpoint comes up FIRST: a port conflict must fail the call
     # before any other resource (writer thread, checkpoint dirs) spins up
-    from ..telemetry.hooks import note_heartbeat
+    from ..telemetry.hooks import note_heartbeat, runner_cache_misses
 
     reducers = tuple(reducers)
+    # --- performance oracle: model attachment + live drift detector ------
+    model_step_s = model_bound = model_source = None
+    if perf_model is not None:
+        if isinstance(perf_model, dict):
+            model_step_s = perf_model.get("step_s")
+            model_bound = perf_model.get("bound")
+            model_source = perf_model.get("profile_source")
+        else:
+            model_step_s = perf_model
+        try:
+            model_step_s = float(model_step_s)
+        except (TypeError, ValueError):
+            model_step_s = None
+        if not model_step_s or model_step_s <= 0:
+            raise InvalidArgumentError(
+                "perf_model must be a telemetry.predict_step record (with "
+                "a positive 'step_s') or modeled per-step seconds; got "
+                f"{perf_model!r}.")
+    watch = None
+    if int(perf_window) > 0:
+        from ..telemetry.perfmodel import PerfWatch
+
+        watch = PerfWatch(window=int(perf_window), zmax=float(perf_zmax),
+                          model_step_s=model_step_s)
     server = None
     if metrics_port is not None:
         from ..telemetry.server import start_metrics_server
@@ -288,6 +328,9 @@ def run_resilient(step_local, state: dict, nt: int, *,
                      snapshots=writer is not None,
                      snapshot_every=snapshot_every if writer else None,
                      reducers=len(reducers))
+        if model_step_s is not None:
+            record_event("perf_model", step_s=model_step_s,
+                         bound=model_bound, source=model_source)
     except BaseException:
         # a failed setup must not leak the endpoint or the writer thread
         if writer is not None:
@@ -404,6 +447,7 @@ def run_resilient(step_local, state: dict, nt: int, *,
 
             ndims = tuple(state[k].ndim for k in names)
             sizes = [int(np.prod(state[k].shape)) for k in names]
+            misses0 = runner_cache_misses() if watch is not None else 0.0
             t_build0 = time.monotonic()
             if reducers:
                 from ..io.reducers import build_reducer_plan, \
@@ -447,6 +491,16 @@ def run_resilient(step_local, state: dict, nt: int, *,
                          reasons=list(rep.reasons),
                          build_s=t_exec0 - t_build0,
                          exec_s=t_done - t_exec0)
+            if watch is not None:
+                # live drift detection: pure host arithmetic per boundary
+                # (a cold chunk — its dispatch paid the XLA compile after
+                # a runner-cache miss — updates gauges only)
+                verdict = watch.observe(
+                    chunk=rep.chunk, step_begin=step, step_end=nb, n=n,
+                    exec_s=t_done - t_exec0,
+                    cold=runner_cache_misses() > misses0)
+                if verdict is not None:
+                    record_event("perf_regression", **verdict)
             if plan is not None:
                 from ..telemetry.hooks import observe_reducers
 

@@ -31,6 +31,20 @@ reference's whole surface, SURVEY §5.4):
 - `server` — `start_metrics_server`: opt-in stdlib HTTP thread serving
   ``/metrics`` (Prometheus exposition) and ``/healthz`` (driver
   heartbeat age); started by `run_resilient(metrics_port=...)`.
+- `perfmodel` — the performance ORACLE (ISSUE 6 tentpole): `predict_step`
+  combines the static halo wire plan, per-model stencil workloads, and a
+  `MachineProfile` of measured coefficients into per-step compute/comm/
+  exposed-comm predictions with a latency/bandwidth/compute-bound
+  verdict; `PerfWatch` is the live drift detector the driver feeds
+  (rolling median+MAD baseline, ``perf_regression`` events,
+  ``igg_perf_*`` gauges).
+- `calibrate` — `calibrate_machine`: short measured runs (sharded triad,
+  FMA chain, per-axis ppermute-pair two-point fits) that produce the
+  machine-profile JSON the model consumes.
+- `perfdb` — the perf-history database and gate: `perfdb_add` appends
+  each bench run to a JSONL history, `perfdb_check` fails metrics that
+  regress beyond a threshold vs the trailing window (the ``tools perfdb``
+  CLI and `bench_all.py`'s self-gate).
 
 All instrumentation is HOST-side: compiled chunk programs are unchanged
 (`tests/test_hlo_audit.py` proves identical collective and fetch counts)
@@ -40,9 +54,16 @@ and the measured overhead sits under the 2% gate (`bench_telemetry.py`).
 from .aggregate import (
     aggregate_events, aggregate_flight, mesh_section, straggler_report,
 )
+from .calibrate import calibrate_machine
 from .export import prometheus_snapshot
 from .hooks import account_halo_exchange, note_heartbeat, \
     note_runner_cache, observe_checkpoint
+from .perfdb import metric_direction, perfdb_add, perfdb_check, perfdb_load
+from .perfmodel import (
+    MachineProfile, PerfWatch, STEP_WORKLOADS, StepWorkload,
+    default_machine_profile, load_machine_profile, predict_step,
+    save_machine_profile,
+)
 from .recorder import (
     FlightRecorder, flight_recorder, read_flight_events, record_event,
     record_span, start_flight_recorder, stop_flight_recorder,
@@ -70,4 +91,8 @@ __all__ = [
     "metrics_server",
     "note_runner_cache", "account_halo_exchange", "observe_checkpoint",
     "note_heartbeat",
+    "MachineProfile", "StepWorkload", "STEP_WORKLOADS", "PerfWatch",
+    "default_machine_profile", "load_machine_profile",
+    "save_machine_profile", "predict_step", "calibrate_machine",
+    "metric_direction", "perfdb_add", "perfdb_check", "perfdb_load",
 ]

@@ -265,7 +265,23 @@ def straggler_report(agg_or_events, *, window: int = 8,
          "persistent": [{proc, first_chunk, last_chunk, chunks, share}],
          "imbalance": {proc: {exec_s_total, compute_s_total, wait_s_total,
                               wait_frac, build_s_total}},
+         "perf_regressions": {events, per_process, chunks: [{chunk,
+                              procs, scope, max_z}], mesh_wide, localized}
+                              | None,
          "summary": {chunks, spread_s_mean, spread_s_max, worst_proc}}
+
+    ``perf_regressions`` classifies the drift detector's
+    ``perf_regression`` events (`telemetry.perfmodel.PerfWatch` via the
+    driver) across the mesh: a chunk flagged by at least half the
+    processes — and never fewer than two, so one sick process can't
+    read as the whole mesh — is a MESH-WIDE slowdown (thermal
+    throttling, a shared-filesystem stall, an interconnect event); one
+    flagged by fewer is LOCALIZED and attributed to the flagging
+    process(es) — the same
+    verdict the arrival-spread analysis gives, but from each process's
+    own baseline, so it also catches a slowdown that hits everyone
+    equally (which barrier spreads are blind to). None when no stream
+    carries perf events.
 
     Arrival model: see the module docstring — arrival = corrected dispatch
     start + min-across-processes ``exec_s`` (the unencumbered compute
@@ -360,6 +376,7 @@ def straggler_report(agg_or_events, *, window: int = 8,
         "slowest_counts": slowest_counts,
         "persistent": persistent,
         "imbalance": imbalance,
+        "perf_regressions": _perf_regressions(events, procs),
         "summary": {
             "chunks": len(chunks),
             "spread_s_mean": (sum(spreads) / len(spreads)) if spreads
@@ -368,6 +385,41 @@ def straggler_report(agg_or_events, *, window: int = 8,
             "worst_proc": (max(slowest_counts, key=slowest_counts.get)
                            if chunks else None),
         },
+    }
+
+
+def _perf_regressions(events, procs) -> dict | None:
+    """Mesh-wide classification of the drift detector's flags (see
+    `straggler_report`). ``procs`` is the straggler analysis's process
+    list — the mesh-wide threshold counts against EVERY process with
+    chunk events, not just the flagging ones."""
+    flags = [e for e in events if e.get("kind") == "perf_regression"]
+    if not flags:
+        return None
+    by_chunk: dict = {}
+    per_proc: dict = {}
+    for e in flags:
+        p = int(e.get("proc", 0))
+        per_proc[p] = per_proc.get(p, 0) + 1
+        rec = by_chunk.setdefault(e.get("chunk"), {"procs": set(),
+                                                   "max_z": 0.0})
+        rec["procs"].add(p)
+        rec["max_z"] = max(rec["max_z"], float(e.get("z", 0.0) or 0.0))
+    need = max(2, (len(procs) + 1) // 2)  # at least half the mesh
+    chunks = []
+    mesh_wide = 0
+    for c in sorted(by_chunk, key=lambda x: (x is None, x)):
+        rec = by_chunk[c]
+        scope = "mesh-wide" if len(rec["procs"]) >= need else "process"
+        mesh_wide += scope == "mesh-wide"
+        chunks.append({"chunk": c, "procs": sorted(rec["procs"]),
+                       "scope": scope, "max_z": rec["max_z"]})
+    return {
+        "events": len(flags),
+        "per_process": per_proc,
+        "chunks": chunks,
+        "mesh_wide": mesh_wide,
+        "localized": len(chunks) - mesh_wide,
     }
 
 
@@ -389,6 +441,7 @@ def mesh_section(agg_or_events, *, window: int = 8,
         "slowest_counts": rep["slowest_counts"],
         "persistent_stragglers": rep["persistent"],
         "imbalance": rep["imbalance"],
+        "perf_regressions": rep["perf_regressions"],
         "summary": rep["summary"],
     }
     if isinstance(agg_or_events, dict):

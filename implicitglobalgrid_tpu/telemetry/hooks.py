@@ -17,7 +17,8 @@ from .registry import metrics_registry
 
 __all__ = ["note_runner_cache", "account_halo_exchange",
            "observe_checkpoint", "observe_snapshot", "note_io_queue",
-           "observe_reducers", "note_heartbeat"]
+           "observe_reducers", "note_heartbeat", "observe_perf",
+           "note_metrics_server_port"]
 
 # Metric family names (the exported contract; see docs/observability.md).
 RUNNER_CACHE = "igg_runner_cache_total"
@@ -33,6 +34,19 @@ IO_QUEUE_DEPTH = "igg_io_queue_depth"
 REDUCER_VALUE = "igg_reducer_value"
 HEARTBEAT_TS = "igg_driver_heartbeat_timestamp_seconds"
 HEARTBEAT_STEP = "igg_driver_step"
+PERF_STEP_S = "igg_perf_step_seconds"
+PERF_RATIO = "igg_perf_model_ratio"
+PERF_Z = "igg_perf_zscore"
+PERF_REGRESSIONS = "igg_perf_regressions_total"
+METRICS_SERVER_PORT = "igg_metrics_server_port"
+
+
+def runner_cache_misses() -> float:
+    """Current ``miss`` count of the runner-cache family (0 before any
+    runner was built) — the driver diffs it around a runner build to tag
+    COLD chunks for the perf drift detector."""
+    fam = metrics_registry().get(RUNNER_CACHE)
+    return fam.value(result="miss") if fam is not None else 0.0
 
 
 def note_runner_cache(result: str, build_s: float | None = None) -> None:
@@ -149,6 +163,42 @@ def note_heartbeat(step) -> None:
               "boundary (unix seconds).").set(time.time())
     reg.gauge(HEARTBEAT_STEP,
               "Last step the resilient driver committed.").set(step)
+
+
+def observe_perf(per_step_s: float, *, ratio=None, z=None,
+                 regression: bool = False) -> None:
+    """Record one chunk boundary's perf-oracle observation
+    (`telemetry.perfmodel.PerfWatch`): the measured per-step time, the
+    measured/modeled ratio (when a model prediction backs the run), the
+    rolling robust z-score vs the chunk baseline, and the regression
+    counter. Gauge writes only — the whole per-boundary cost of the live
+    drift detector (gated in bench_perf.py)."""
+    reg = metrics_registry()
+    reg.gauge(PERF_STEP_S,
+              "Measured per-step execution time of the last chunk "
+              "(exec_s / steps).").set(per_step_s)
+    if ratio is not None:
+        reg.gauge(PERF_RATIO,
+                  "Measured / modeled per-step time (perfmodel."
+                  "predict_step backing the run).").set(ratio)
+    if z is not None:
+        reg.gauge(PERF_Z,
+                  "Rolling robust z-score of the last chunk's per-step "
+                  "time vs the median+MAD baseline.").set(z)
+    if regression:
+        reg.counter(PERF_REGRESSIONS,
+                    "Chunks flagged by the perf drift detector "
+                    "(perf_regression flight events).").inc(1)
+
+
+def note_metrics_server_port(port: int) -> None:
+    """Expose the ACTUAL bound port of the live metrics endpoint (the
+    ephemeral-port contract: start with port=0, read the gauge — or the
+    returned server's ``.port`` — instead of hard-coding)."""
+    metrics_registry().gauge(
+        METRICS_SERVER_PORT,
+        "TCP port the live /metrics+/healthz endpoint is bound to "
+        "(0 = no server started yet this process).").set(int(port))
 
 
 def observe_reducers(step, values: dict, *, ok: bool = True) -> None:

@@ -1,0 +1,418 @@
+"""The performance oracle: an analytical per-chunk cost model + drift watch.
+
+PRs 3 and 5 made every run *measurable* (flight recorder, metrics,
+mesh-wide aggregation); nothing could say whether a measurement was
+*good*. This module is the missing judgment: a roofline over the implicit
+global grid that combines
+
+- the static halo wire plan (`ops.halo.halo_comm_plan` — bytes on wire,
+  collective counts, wire dtype; already derived from shapes alone),
+- a per-model step workload (stencil FLOPs + HBM traffic per cell,
+  `STEP_WORKLOADS`), and
+- a `MachineProfile` of MEASURED coefficients (achieved memory bandwidth,
+  per-mesh-axis link bandwidth and collective latency —
+  `telemetry.calibrate.calibrate_machine`; spec-based defaults exist but
+  are labeled as such)
+
+into a prediction of per-step compute time, per-axis communication time,
+and exposed (un-overlapped) communication, classifying each configuration
+as **latency-**, **bandwidth-**, or **compute-bound** (`predict_step`).
+This is the substrate the ROADMAP's hierarchical-mesh auto-tuner needs:
+picking ``comm_every`` / ``wire_dtype`` / coalescing per axis becomes a
+search over this model instead of a from-scratch subsystem.
+
+The live half is `PerfWatch`: a rolling per-chunk baseline (median + MAD
+over a window, robust z-score) plus the measured/modeled ratio, driven by
+`runtime/driver.py` at every chunk boundary — pure host arithmetic, zero
+device work. A chunk whose per-step time drifts beyond the z threshold
+emits a ``perf_regression`` flight event and the ``igg_perf_*`` gauges
+feed the live ``/metrics`` endpoint; the PR-5 aggregation then
+distinguishes a mesh-wide slowdown from one sick process
+(`aggregate.straggler_report` ``perf_regressions``).
+
+Everything here is host-side: the compiled chunk program is bit-identical
+with the oracle on or off (tests/test_hlo_audit.py) and the per-boundary
+cost is a few float ops (`bench_perf.py`, gated < 2%).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from dataclasses import dataclass, field as dc_field
+
+from ..utils.exceptions import InvalidArgumentError
+
+__all__ = ["MachineProfile", "StepWorkload", "STEP_WORKLOADS",
+           "default_machine_profile", "load_machine_profile",
+           "save_machine_profile", "predict_step", "PerfWatch"]
+
+_PROFILE_VERSION = 1
+
+
+@dataclass(frozen=True)
+class MachineProfile:
+    """Measured (or default) machine coefficients the cost model consumes.
+
+    ``membw_GBps``/``flops_G`` are PER-DEVICE achieved rates (on the
+    emulated CPU mesh the virtual devices share the host's cores — a
+    calibration over the live mesh measures exactly that contention,
+    which is why calibrated beats spec'ed). ``axes`` maps mesh axis names
+    (``gx``/``gy``/``gz``) to ``{"GBps", "latency_s"}``: the effective
+    one-direction link bandwidth and the per-ppermute-PAIR launch latency
+    of an exchange along that axis (both directions' concurrency is
+    absorbed into the effective bandwidth — the calibration measures the
+    same forward+backward pair shape the exchange issues).
+    ``source`` is ``"calibrated"`` or ``"default"`` so a prediction can
+    always say whether measured coefficients backed it."""
+
+    membw_GBps: float
+    flops_G: float
+    axes: dict
+    source: str = "default"
+    device: dict | None = None
+    calibrated_at: float | None = None
+    meta: dict = dc_field(default_factory=dict)
+
+    def axis(self, name: str) -> dict:
+        """Link coefficients for one mesh axis (falls back to the mean of
+        the calibrated axes, then to conservative defaults, so a profile
+        calibrated on a 1-D mesh still prices a 3-D one)."""
+        rec = self.axes.get(name)
+        if rec and rec.get("GBps"):
+            return rec
+        have = [r for r in self.axes.values() if r and r.get("GBps")]
+        if have:
+            return {"GBps": sum(r["GBps"] for r in have) / len(have),
+                    "latency_s": sum(r.get("latency_s", 0.0)
+                                     for r in have) / len(have)}
+        return {"GBps": 1.0, "latency_s": 1e-4}
+
+    def to_json(self) -> dict:
+        return {"version": _PROFILE_VERSION,
+                "membw_GBps": self.membw_GBps, "flops_G": self.flops_G,
+                "axes": self.axes, "source": self.source,
+                "device": self.device, "calibrated_at": self.calibrated_at,
+                "meta": self.meta}
+
+
+def default_machine_profile(device_type: str | None = None) -> MachineProfile:
+    """Spec-flavored fallback coefficients (``source="default"``) — use
+    `telemetry.calibrate.calibrate_machine` for measured ones. With no
+    argument, the current grid's device type is used."""
+    if device_type is None:
+        from ..parallel.topology import global_grid
+
+        device_type = global_grid().device_type
+    if device_type == "tpu":
+        # v5e-flavored: ~800 GB/s HBM, ~45 GB/s/direction ICI per link,
+        # microsecond-scale collective launch; f32 vector flops
+        axes = {a: {"GBps": 45.0, "latency_s": 5e-6}
+                for a in ("gx", "gy", "gz")}
+        return MachineProfile(membw_GBps=800.0, flops_G=45000.0, axes=axes,
+                              source="default",
+                              device={"platform": "tpu"})
+    # emulated CPU mesh: the 8 virtual devices share one host's cores
+    axes = {a: {"GBps": 4.0, "latency_s": 3e-5} for a in ("gx", "gy", "gz")}
+    return MachineProfile(membw_GBps=6.0, flops_G=6.0, axes=axes,
+                          source="default",
+                          device={"platform": device_type or "cpu"})
+
+
+def save_machine_profile(profile: MachineProfile, path) -> str:
+    """Persist a profile as JSON (the file `load_machine_profile` and the
+    ``tools calibrate`` CLI exchange)."""
+    path = os.fspath(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(profile.to_json(), f, indent=1)
+    return path
+
+
+def load_machine_profile(path) -> MachineProfile:
+    path = os.fspath(path)
+    try:
+        with open(path, encoding="utf-8") as f:
+            rec = json.load(f)
+    except (OSError, ValueError) as e:
+        raise InvalidArgumentError(
+            f"load_machine_profile: cannot read {path}: {e}") from e
+    try:
+        return MachineProfile(
+            membw_GBps=float(rec["membw_GBps"]),
+            flops_G=float(rec["flops_G"]),
+            axes={str(k): dict(v) for k, v in rec.get("axes", {}).items()},
+            source=str(rec.get("source", "calibrated")),
+            device=rec.get("device"),
+            calibrated_at=rec.get("calibrated_at"),
+            meta=rec.get("meta", {}))
+    except (KeyError, TypeError, ValueError) as e:
+        raise InvalidArgumentError(
+            f"load_machine_profile: {path} is not a machine profile "
+            f"({e}).") from e
+
+
+@dataclass(frozen=True)
+class StepWorkload:
+    """Per-cell step cost + exchange structure of one model family.
+
+    ``flops_per_cell`` counts the stencil arithmetic (priced at the
+    profile's STENCIL-calibrated FLOP rate — slice-heavy code, not peak
+    FMA); ``hbm_passes`` the HBM traffic in array passes (bytes = passes
+    * itemsize * cells: state reads + writes plus a slack pass for
+    materialized intermediates). ``exchange_groups`` describes how the
+    step actually calls the exchange: one tuple of FIELD INDICES per
+    `local_update_halo` round (fields in one round coalesce into one
+    ppermute pair per axis; separate rounds pay separate launches) —
+    diffusion exchanges only T, the acoustic leapfrog does a V round
+    then a P round. Deliberate single-digit precision throughout: the
+    model's job is picking the right regime and being within 2x, not
+    reproducing a cycle simulator."""
+
+    flops_per_cell: float
+    hbm_passes: float
+    exchange_groups: tuple = ((0,),)
+
+
+# One entry per model family in `models/` (validated against the measured
+# bench configs in bench_perf.py / BENCH_ALL.json `model_ratio` fields).
+STEP_WORKLOADS = {
+    # flux (3 diffs, 3 muls) + divergence (5) + Cp array-div + update;
+    # only T is exchanged (Cp is a constant coefficient field)
+    "diffusion3d": StepWorkload(flops_per_cell=22.0, hbm_passes=4.0,
+                                exchange_groups=((0,),)),
+    "diffusion2d": StepWorkload(flops_per_cell=14.0, hbm_passes=4.0,
+                                exchange_groups=((0,),)),
+    # state (P, Vx, Vy, Vz): the leapfrog exchanges the 3 V fields in one
+    # coalesced round, then P in its own round (overlapped when enabled)
+    "acoustic3d": StepWorkload(flops_per_cell=20.0, hbm_passes=8.0,
+                               exchange_groups=((1, 2, 3), (0,))),
+    # state (P, Vx, Vy, Vz, dVx, dVy, dVz, rhog): one coalesced round of
+    # the 4 wave fields per PT iteration (models/stokes.py:185)
+    "stokes3d": StepWorkload(flops_per_cell=60.0, hbm_passes=16.0,
+                             exchange_groups=((1, 2, 3, 0),)),
+}
+
+
+def _axis_npairs(gg, dim: int) -> int:
+    """Number of directed links an exchange's ppermute pair spans along
+    ``dim`` (the divisor that turns the plan's all-links ``wire_bytes``
+    into the one-direction per-link payload the link model prices)."""
+    from ..ops.halo import _perm_pairs
+
+    D = int(gg.dims[dim])
+    periodic = bool(gg.periods[dim])
+    perm_p, perm_m = _perm_pairs(D, periodic, int(gg.disp))
+    return len(perm_p) + len(perm_m)
+
+
+def predict_step(model, fields, *, profile: MachineProfile | None = None,
+                 comm_every: int = 1, overlap: bool = False,
+                 dims=None, coalesce=None, wire_dtype=None) -> dict:
+    """Predict one step's cost on the CURRENT grid for stacked ``fields``.
+
+    ``model`` is a `STEP_WORKLOADS` key or a `StepWorkload`; ``fields``
+    are the stacked state arrays (or anything with shape/dtype) in the
+    model's canonical state order — the workload's ``exchange_groups``
+    index into them to price each exchange round exactly as the step
+    issues it (same argument forms as `halo_comm_plan`).
+    ``profile`` defaults to `default_machine_profile()` (pass a
+    calibrated one for measured coefficients). ``comm_every=k`` prices
+    the deep-halo cadence: the exchange (whose k-wide slabs the fields'
+    halowidths already describe) is charged once per k steps.
+    ``overlap`` credits communication that hides behind interior compute
+    (`hide_communication` / the latency-hiding scheduler): exposed comm
+    = max(0, comm - compute) instead of comm.
+
+    Returns a record with per-step seconds and the roofline verdict::
+
+        {"model", "profile_source", "local_cells",
+         "compute": {"flops", "hbm_bytes", "flops_s", "hbm_s", "s"},
+         "comm":    {axis: {"ppermute_pairs", "per_link_bytes",
+                            "latency_s", "wire_s", "s"}, ...},
+         "local_copy_s", "comm_s", "exposed_comm_s",
+         "step_s", "bound", "bound_detail", "terms"}
+
+    ``bound`` is the largest cost term's class — ``"compute"`` (FLOPs),
+    ``"bandwidth"`` (HBM or wire bytes; ``bound_detail`` says which), or
+    ``"latency"`` (collective launches) — the knob-picking signal: a
+    latency-bound config wants ``comm_every``/coalescing, a
+    bandwidth-bound one wants ``wire_dtype``, a compute-bound one is
+    already at the roofline."""
+    from ..ops.halo import halo_comm_plan
+    from ..parallel.topology import check_initialized, global_grid
+
+    check_initialized()
+    gg = global_grid()
+    if isinstance(model, StepWorkload):
+        work, model_name = model, "custom"
+    else:
+        work = STEP_WORKLOADS.get(str(model))
+        if work is None:
+            raise InvalidArgumentError(
+                f"predict_step: unknown model {model!r} (have "
+                f"{sorted(STEP_WORKLOADS)}; or pass a StepWorkload).")
+        model_name = str(model)
+    profile = profile if profile is not None else default_machine_profile()
+    k = max(1, int(comm_every))
+
+    # one wire plan per exchange ROUND the step actually performs (fields
+    # in a round coalesce; separate rounds pay separate launches), merged
+    # into per-axis totals
+    fields = tuple(fields)
+    plan = {"axes": {}, "local_copy_bytes": 0}
+    for group in work.exchange_groups:
+        if any(i >= len(fields) for i in group):
+            raise InvalidArgumentError(
+                f"predict_step: model {model_name!r} expects at least "
+                f"{max(group) + 1} fields in its state order "
+                f"(exchange group {group}); got {len(fields)}.")
+        sub = halo_comm_plan(*(fields[i] for i in group), dims=dims,
+                             coalesce=coalesce, wire_dtype=wire_dtype)
+        for axis, rec in sub["axes"].items():
+            dst = plan["axes"].setdefault(
+                axis, {"ppermutes": 0, "wire_bytes": 0})
+            dst["ppermutes"] += rec["ppermutes"]
+            dst["wire_bytes"] += rec["wire_bytes"]
+        plan["local_copy_bytes"] += sub["local_copy_bytes"]
+    # interior cells of the primary (first) field's LOCAL block
+    f0 = fields[0]
+    shape0 = tuple(int(s) for s in f0.shape)
+    local_cells = 1
+    for d, s in enumerate(shape0):
+        local_cells *= s // int(gg.dims[d]) if d < 3 else s
+
+    itemsize = _itemsize_of(f0)
+    flops = work.flops_per_cell * local_cells
+    hbm_bytes = work.hbm_passes * itemsize * local_cells
+    flops_s = flops / (profile.flops_G * 1e9)
+    hbm_s = hbm_bytes / (profile.membw_GBps * 1e9)
+    compute_s = max(flops_s, hbm_s)
+
+    axis_dims = {"gx": 0, "gy": 1, "gz": 2}
+    comm = {}
+    lat_total = wire_total = 0.0
+    for axis, rec in plan["axes"].items():
+        npairs = _axis_npairs(gg, axis_dims[axis])
+        per_link = (rec["wire_bytes"] / npairs) if npairs else 0.0
+        coeff = profile.axis(axis)
+        pairs = rec["ppermutes"] / 2.0
+        lat_s = pairs * float(coeff.get("latency_s", 0.0)) / k
+        wire_s = per_link / (float(coeff["GBps"]) * 1e9) / k
+        comm[axis] = {"ppermute_pairs": pairs, "per_link_bytes": per_link,
+                      "latency_s": lat_s, "wire_s": wire_s,
+                      "s": lat_s + wire_s}
+        lat_total += lat_s
+        wire_total += wire_s
+    # self-neighbor local slab swaps never touch the wire: they are HBM
+    # traffic (read + write) at the memory-bandwidth coefficient
+    local_copy_s = (2.0 * plan["local_copy_bytes"]
+                    / (profile.membw_GBps * 1e9)) / k
+    comm_s = lat_total + wire_total + local_copy_s
+    exposed = max(0.0, comm_s - compute_s) if overlap else comm_s
+    step_s = compute_s + exposed
+
+    # roofline verdict: the largest EXPOSED term names the regime
+    scale = (exposed / comm_s) if (overlap and comm_s > 0) else 1.0
+    terms = {"flops_s": flops_s, "hbm_s": hbm_s,
+             "latency_s": lat_total * scale,
+             "wire_s": (wire_total + local_copy_s) * scale}
+    worst = max(terms, key=terms.get)
+    bound = {"flops_s": "compute", "hbm_s": "bandwidth",
+             "latency_s": "latency", "wire_s": "bandwidth"}[worst]
+    detail = {"flops_s": "flops", "hbm_s": "hbm",
+              "latency_s": "collective-launch", "wire_s": "wire"}[worst]
+    return {
+        "model": model_name,
+        "profile_source": profile.source,
+        "local_cells": local_cells,
+        "compute": {"flops": flops, "hbm_bytes": hbm_bytes,
+                    "flops_s": flops_s, "hbm_s": hbm_s, "s": compute_s},
+        "comm": comm,
+        "local_copy_s": local_copy_s,
+        "comm_s": comm_s,
+        "exposed_comm_s": exposed,
+        "step_s": step_s,
+        "bound": bound,
+        "bound_detail": detail,
+        "terms": terms,
+    }
+
+
+def _itemsize_of(f) -> int:
+    import numpy as np
+
+    try:
+        return int(np.dtype(f.dtype).itemsize)
+    except Exception:
+        return 4
+
+
+class PerfWatch:
+    """Live drift detector over per-chunk step times (host-side only).
+
+    The driver feeds it one observation per chunk boundary
+    (``observe(...)``); it maintains a rolling baseline of per-STEP
+    execution time (median + MAD over ``window`` chunks — robust to the
+    occasional slow fetch) and a modeled ratio when a prediction is
+    given. An observation whose robust z-score
+
+        z = (per_step - median) / max(1.4826 * MAD, rel_floor * median)
+
+    exceeds ``zmax`` (after ``min_samples`` warm-up chunks) returns a
+    regression record the driver emits as a ``perf_regression`` flight
+    event. Chunks marked ``cold`` (the dispatch paid an XLA compile after
+    a runner-cache miss) update the gauges but neither test nor pollute
+    the baseline. Every observation lands in the ``igg_perf_*`` gauges
+    (`telemetry.hooks.observe_perf`), so the live ``/metrics`` endpoint
+    always shows the current per-step time, model ratio, and z-score."""
+
+    def __init__(self, *, window: int = 16, zmax: float = 4.0,
+                 model_step_s: float | None = None, min_samples: int = 5,
+                 rel_floor: float = 0.02):
+        if window < 2:
+            raise InvalidArgumentError(
+                f"PerfWatch needs window >= 2 (got {window}).")
+        self.window = int(window)
+        self.zmax = float(zmax)
+        # clamped to the window: a deque of maxlen=window can never hold
+        # min_samples > window entries, which would silently disable the
+        # z-test for small perf_window values
+        self.min_samples = max(2, min(int(min_samples), self.window))
+        self.rel_floor = float(rel_floor)
+        self.model_step_s = (None if model_step_s is None
+                             else float(model_step_s))
+        self._hist: deque = deque(maxlen=self.window)
+        self.regressions = 0
+
+    def observe(self, *, chunk, step_begin, step_end, n, exec_s,
+                cold: bool = False) -> dict | None:
+        """One chunk boundary. Returns the regression record (or None)."""
+        from statistics import median
+
+        from .hooks import observe_perf
+
+        per_step = float(exec_s) / max(1, int(n))
+        ratio = (per_step / self.model_step_s
+                 if self.model_step_s else None)
+        z = None
+        verdict = None
+        if len(self._hist) >= self.min_samples:
+            med = median(self._hist)
+            mad = median([abs(x - med) for x in self._hist])
+            sigma = max(1.4826 * mad, self.rel_floor * med, 1e-12)
+            z = (per_step - med) / sigma
+            if not cold and z > self.zmax:
+                self.regressions += 1
+                verdict = {"chunk": chunk, "step_begin": step_begin,
+                           "step_end": step_end, "per_step_s": per_step,
+                           "baseline_s": med, "mad_s": mad, "z": z,
+                           "ratio": ratio}
+        if not cold:
+            self._hist.append(per_step)
+        observe_perf(per_step, ratio=ratio, z=z,
+                     regression=verdict is not None)
+        return verdict

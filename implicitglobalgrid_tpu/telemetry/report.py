@@ -42,8 +42,42 @@ _SEQ_FIELDS = {
     "snapshot_writer_close": ("submitted", "written", "staged", "dropped",
                               "errors", "bytes"),
     "reducers": ("step", "ok", "values"),
+    "perf_model": ("step_s", "bound", "source"),
+    "perf_regression": ("chunk", "step_begin", "step_end", "per_step_s",
+                        "baseline_s", "z", "ratio"),
     "run_end": ("completed", "chunks"),
 }
+
+
+def _perf_section(chunks: list, perf_model: dict | None,
+                  regressions: list) -> dict:
+    """The report's ``"perf"`` block: the per-step time series of the OK
+    warm chunks (cold chunks pay the XLA compile inside their dispatch
+    and would skew every quantile), the attached model prediction with
+    the measured/modeled ratio, and the drift detector's verdicts."""
+    from statistics import median
+
+    per_step = sorted(
+        c["exec_s"] / max(1, c.get("n", 1)) for c in chunks
+        if c.get("ok") and not c.get("cold")
+        and "exec_s" in c and c.get("n"))
+    med = median(per_step) if per_step else None
+    out = {
+        "chunks": len(per_step),
+        "step_s_median": med,
+        "step_s_min": per_step[0] if per_step else None,
+        "step_s_max": per_step[-1] if per_step else None,
+        "regressions": len(regressions),
+        "worst_z": max((r.get("z", 0.0) for r in regressions),
+                       default=None),
+    }
+    if perf_model is not None:
+        out["model_step_s"] = perf_model.get("step_s")
+        out["bound"] = perf_model.get("bound")
+        out["model_source"] = perf_model.get("source")
+        if med and perf_model.get("step_s"):
+            out["model_ratio_median"] = med / float(perf_model["step_s"])
+    return out
 
 
 def _pick(ev: dict, fields: tuple) -> dict:
@@ -129,6 +163,7 @@ def run_report(source, *, run_id: str | None = None,
     chunks, cache = [], {"hits": 0, "misses": 0, "uncached": 0}
     saves, restores, rollbacks = [], [], []
     trips, escalations, elastic = [], [], []
+    perf_model, perf_regressions = None, []
     begin = end = None
     halo = {"exchanges": 0, "ppermutes": 0, "wire_bytes": 0}
     io = {"snapshots_submitted": 0, "snapshots_written": 0,
@@ -178,6 +213,10 @@ def run_report(source, *, run_id: str | None = None,
             io["snapshot_errors"] += 1
         elif k == "reducers":
             io["reducer_points"] += 1
+        elif k == "perf_model":
+            perf_model = e
+        elif k == "perf_regression":
+            perf_regressions.append(e)
         elif k == "run_begin":
             begin = e
         elif k == "run_end":
@@ -225,6 +264,7 @@ def run_report(source, *, run_id: str | None = None,
             for e in elastic],
         "halo": halo,
         "io": io,
+        "perf": _perf_section(chunks, perf_model, perf_regressions),
         "sequence": sequence,
     }
     if mesh is not None:

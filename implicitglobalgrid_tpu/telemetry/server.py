@@ -86,6 +86,12 @@ class MetricsServer:
             target=self._httpd.serve_forever,
             name=f"igg-metrics-server:{self.port}", daemon=True)
         self._thread.start()
+        # ephemeral-port contract: port=0 binds a free port; the ACTUAL
+        # port is readable from .port and from this gauge, so tests and
+        # multi-tenant runs never hard-code (and collide on) a number
+        from .hooks import note_metrics_server_port
+
+        note_metrics_server_port(self.port)
 
     def _healthz(self):
         """(status_code, record): heartbeat age from the driver gauge."""
@@ -111,6 +117,9 @@ class MetricsServer:
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)
+        from .hooks import note_metrics_server_port
+
+        note_metrics_server_port(0)  # gauge reads 0 while no endpoint lives
 
     def __enter__(self):
         return self
@@ -130,9 +139,10 @@ def start_metrics_server(port: int = 0, *, host: str = "127.0.0.1",
                          ) -> MetricsServer:
     """Start THE process metrics server (one per process — a second start
     without a stop raises; scrapers address one stable port). ``port=0``
-    binds an ephemeral port, read it from the returned server's
-    ``.port``. Binds ``127.0.0.1`` unless ``host`` says otherwise (see
-    the module docstring's security note)."""
+    binds an ephemeral port; the ACTUAL port is the returned server's
+    ``.port`` and the ``igg_metrics_server_port`` gauge (0 again after
+    stop). Binds ``127.0.0.1`` unless ``host`` says otherwise (see the
+    module docstring's security note)."""
     global _current
     with _lock:
         if _current is not None:

@@ -12,7 +12,9 @@ directly — so a multi-process run becomes one navigable timeline:
 - guard trips, rollbacks, escalations, elastic restarts, and fault
   injections as INSTANT events (the red flags an operator scans for);
 - COUNTER tracks per process for ``igg_io_queue_depth`` (the writer's
-  live backpressure) and cumulative halo wire bytes.
+  live backpressure), cumulative halo wire bytes, and the perf oracle's
+  per-step execution time (``igg_perf_step_seconds`` — drift is visible
+  as a rising counter next to its ``perf_regression`` instant marker).
 
 Timestamps are the aggregated stream's corrected wall clock (barrier-
 aligned across processes, `docs/observability.md` "Mesh-wide view"),
@@ -35,7 +37,8 @@ __all__ = ["export_chrome_trace"]
 # Instant-event kinds (the operator's red flags), with the scope chrome
 # renders them at: process-wide bars.
 _INSTANTS = ("guard_trip", "rollback", "escalation", "elastic_restart",
-             "fault_injected", "snapshot_drop", "snapshot_error")
+             "fault_injected", "snapshot_drop", "snapshot_error",
+             "perf_regression")
 
 _TID_DRIVER = 0
 _TID_IO = 1
@@ -142,6 +145,14 @@ def export_chrome_trace(source, out=None, *, run_id: str | None = None):
                 trace.append({"ph": "X", "pid": p, "tid": _TID_DRIVER,
                               "cat": "chunk", "name": "exec",
                               "ts": us(t - ex), "dur": ex * 1e6})
+            # perf-oracle counter track: per-step execution time per
+            # boundary — the drift an operator eyeballs next to the
+            # perf_regression instant markers
+            if e.get("n"):
+                trace.append({"ph": "C", "pid": p,
+                              "name": "igg_perf_step_seconds",
+                              "ts": us(t),
+                              "args": {"s": ex / max(1, int(e["n"]))}})
         elif kind in ("checkpoint_save", "checkpoint_restore"):
             dur = float(e.get("dur_s", 0.0) or 0.0)
             trace.append({"ph": "X", "pid": p, "tid": _TID_DRIVER,

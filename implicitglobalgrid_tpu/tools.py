@@ -242,6 +242,17 @@ def _cli(argv=None) -> int:
       imbalance report (`telemetry.straggler_report`): per-chunk
       barrier-arrival spreads, slowest-process attribution, persistent-
       straggler flags, wait/compute imbalance.
+    - ``perfdb add <bench.json> --db HISTORY.jsonl`` — append a bench
+      run (BENCH_ALL.json shape) to the perf-history database;
+      ``perfdb check <bench.json> --db HISTORY.jsonl`` gates it against
+      the trailing window (`telemetry.perfdb_check`) and EXITS 1 on a
+      regression — the CI hook that makes the bench trajectory gate
+      itself.
+    - ``calibrate [--out profile.json] [--cpu]`` — measure this machine's
+      profile (`telemetry.calibrate_machine`: achieved memory bandwidth,
+      FLOP rate, per-mesh-axis link bandwidth/latency) on a
+      self-initialized grid and print/persist the JSON the cost model
+      (`telemetry.predict_step`) consumes.
     """
     import argparse
     import json
@@ -309,9 +320,91 @@ def _cli(argv=None) -> int:
     stp.add_argument("--share", type=float, default=0.5,
                      help="slowest-share above which a window flags")
     stp.add_argument("--indent", type=int, default=2)
+    pdb = sub.add_parser(
+        "perfdb", help="perf-history database: append bench runs, gate "
+                       "regressions vs the trailing window")
+    pdb_sub = pdb.add_subparsers(dest="perfdb_cmd", required=True)
+    pda = pdb_sub.add_parser("add", help="append a bench run to the "
+                                         "history JSONL")
+    pda.add_argument("rows", help="bench rows JSON (BENCH_ALL.json shape)")
+    pda.add_argument("--db", required=True, help="history JSONL path")
+    pda.add_argument("--note", default=None,
+                     help="free-form note stored in the record's meta")
+    pdc = pdb_sub.add_parser(
+        "check", help="gate a bench run against the trailing history "
+                      "(exit 1 on regression)")
+    pdc.add_argument("rows", help="bench rows JSON (BENCH_ALL.json shape)")
+    pdc.add_argument("--db", required=True, help="history JSONL path")
+    pdc.add_argument("--window", type=int, default=5,
+                     help="trailing history records forming the baseline")
+    pdc.add_argument("--threshold", type=float, default=0.30,
+                     help="relative change in the worse direction that "
+                          "fails a metric")
+    pdc.add_argument("--min-history", type=int, default=2,
+                     help="history points a metric needs before it gates")
+    pdc.add_argument("--indent", type=int, default=2)
+    cal = sub.add_parser(
+        "calibrate", help="measure this machine's profile (membw, flops, "
+                          "per-axis link bw/latency) for the cost model")
+    cal.add_argument("--out", default=None,
+                     help="also persist the profile JSON here")
+    cal.add_argument("--nx", type=int, default=32,
+                     help="local block edge of the calibration grid")
+    cal.add_argument("--cpu", action="store_true",
+                     help="profile the 8-device virtual CPU mesh (the "
+                          "bench scripts' convention) instead of the "
+                          "default backend — a single-device backend has "
+                          "no inter-shard link, so axes come out empty")
+    cal.add_argument("--indent", type=int, default=2)
     args = ap.parse_args(argv)
 
     from .telemetry import prometheus_snapshot, run_report
+
+    if args.cmd == "perfdb":
+        from .telemetry import perfdb_add, perfdb_check
+
+        if args.perfdb_cmd == "add":
+            meta = {"note": args.note} if args.note else None
+            rec = perfdb_add(args.db, args.rows, meta=meta)
+            print(json.dumps({"db": args.db, "ts": rec["ts"],
+                              "metrics": len(rec["metrics"])}))
+            return 0
+        rep = perfdb_check(args.db, args.rows, window=args.window,
+                           threshold=args.threshold,
+                           min_history=args.min_history)
+        print(json.dumps(rep, indent=args.indent, default=str))
+        return 0 if rep["ok"] else 1
+    if args.cmd == "calibrate":
+        if args.cpu:
+            # must precede any jax device use (the bench scripts' idiom)
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        from .parallel.grid import finalize_global_grid, init_global_grid
+        from .parallel.topology import grid_is_initialized
+        from .telemetry import calibrate_machine
+
+        owns_grid = not grid_is_initialized()
+        if owns_grid:
+            import jax
+
+            from .parallel.topology import dims_create
+
+            dims = [int(d) for d in dims_create(len(jax.devices()),
+                                                (0, 0, 0))]
+            init_global_grid(args.nx, args.nx, args.nx, dimx=dims[0],
+                             dimy=dims[1], dimz=dims[2], periodx=1,
+                             periody=1, periodz=1, quiet=True)
+        try:
+            profile = calibrate_machine(args.out)
+        finally:
+            if owns_grid:
+                finalize_global_grid()
+        print(json.dumps(profile.to_json(), indent=args.indent))
+        return 0
 
     def _agg_source():
         return args.src[0] if len(args.src) == 1 else args.src

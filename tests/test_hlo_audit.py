@@ -472,15 +472,17 @@ def test_guarded_runner_adds_exactly_one_small_allreduce():
 
 
 def test_telemetry_leaves_chunk_program_untouched(tmp_path):
-    """THE observability wire claim (ISSUE 3 + the ISSUE 5 mesh layer):
-    telemetry is host-side only — building the guarded chunk runner with
-    an ACTIVE flight recorder, live metrics registry, RUNNING metrics
-    server, and fresh driver heartbeats yields a program with identical
+    """THE observability wire claim (ISSUES 3, 5, and 6): telemetry is
+    host-side only — building the guarded chunk runner with an ACTIVE
+    flight recorder, live metrics registry, RUNNING metrics server, fresh
+    driver heartbeats, AND the performance oracle live (a predict_step
+    model attached, a PerfWatch drift detector observing boundaries and
+    stamping the igg_perf_* gauges) yields a program with identical
     collective counts and an identical fetch surface (same output arity,
     same parameter count) as with everything off. Zero extra collectives,
-    zero extra D2H fetches per chunk (cross-process aggregation is pure
-    post-hoc host arithmetic over the JSONLs — nothing to audit in the
-    program; the heartbeat/server are the only RUN-time additions)."""
+    zero extra D2H fetches per chunk (cross-process aggregation and the
+    cost model are pure host arithmetic — the heartbeat/server/watch are
+    the only RUN-time additions)."""
     import re as _re
 
     from implicitglobalgrid_tpu.models import (
@@ -488,8 +490,8 @@ def test_telemetry_leaves_chunk_program_untouched(tmp_path):
     )
     from implicitglobalgrid_tpu.runtime.health import make_guarded_runner
     from implicitglobalgrid_tpu.telemetry import (
-        note_heartbeat, start_flight_recorder, start_metrics_server,
-        stop_flight_recorder, stop_metrics_server,
+        PerfWatch, note_heartbeat, predict_step, start_flight_recorder,
+        start_metrics_server, stop_flight_recorder, stop_metrics_server,
     )
 
     igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2,
@@ -505,9 +507,16 @@ def test_telemetry_leaves_chunk_program_untouched(tmp_path):
     start_metrics_server(0)
     try:
         note_heartbeat(0)
+        pred = predict_step("diffusion3d", (T, Cp))  # host arithmetic only
+        watch = PerfWatch(window=8, model_step_s=pred["step_s"])
+        for i in range(6):  # live drift detector + igg_perf_* gauges
+            watch.observe(chunk=i, step_begin=4 * i, step_end=4 * i + 4,
+                          n=4, exec_s=0.01)
         on = make_guarded_runner(step, (3, 3), nt_chunk=4, key="hlo_tel_on")
         hlo_on = on.lower(T, Cp).compile().as_text()
         out_on = on(T, Cp)
+        watch.observe(chunk=6, step_begin=24, step_end=28, n=4,
+                      exec_s=0.01)
         note_heartbeat(4)
     finally:
         stop_metrics_server()

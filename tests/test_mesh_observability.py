@@ -255,6 +255,71 @@ def test_straggler_needs_two_processes_and_common_chunks(tmp_path):
     assert agg3["align"]["residual_s"][1] is not None
 
 
+def test_straggler_single_process_stream_explicit(tmp_path):
+    """A single-process stream must fail the straggler analysis with a
+    typed error naming the fix (aggregate more streams) — and the
+    report/mesh layers must degrade cleanly instead of fabricating a
+    one-horse race: mesh_section is None, run_report has no 'mesh'."""
+    d = str(tmp_path / "solo")
+    os.makedirs(d)
+    _write_stream(d, 0, clock0=10.0, wall0=100.0)
+    agg = igg.aggregate_flight(d)
+    with pytest.raises(InvalidArgumentError,
+                       match="at least two"):
+        igg.straggler_report(agg)
+    assert telemetry.mesh_section(agg["events"]) is None
+    rep = igg.run_report(d)
+    assert "mesh" not in rep and rep["chunks"]["count"] == 6
+
+
+def test_straggler_process_missing_middle_chunk_events(tmp_path):
+    """A process whose stream lost ONE chunk's record mid-run (e.g. the
+    event was never written because the driver was wedged) keeps its seq
+    gapless — the analyzer must exclude exactly that chunk from the
+    barrier analysis and keep every other chunk attributed."""
+    d = str(tmp_path / "hole")
+    os.makedirs(d)
+    _write_stream(d, 0, clock0=0.0, wall0=100.0)
+    # proc 1's stream: chunk 3's record is simply absent (seq contiguous)
+    path = _write_stream(d, 1, clock0=0.0, wall0=100.0, start_delay=0.05)
+    recs = [json.loads(ln) for ln in open(path)]
+    recs = [r for r in recs if not (r["kind"] == "chunk"
+                                    and r.get("chunk") == 3)]
+    for seq, r in enumerate(recs):
+        r["seq"] = seq
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    rep = igg.straggler_report(igg.aggregate_flight(d))
+    assert rep["summary"]["chunks"] == 5
+    assert [c["chunk"] for c in rep["chunks"]] == [0, 1, 2, 4, 5]
+    assert rep["slowest_counts"] == {0: 0, 1: 5}
+
+
+def test_zero_chunk_crashed_at_start_stream(tmp_path):
+    """A process that died before its first chunk (recorder_open +
+    run_begin only) must not poison the mesh view: it aligns by wall
+    anchor, appears in per_process with zero chunks, and the straggler
+    analysis runs over the surviving processes only."""
+    d = str(tmp_path / "crash")
+    os.makedirs(d)
+    _write_stream(d, 0, clock0=0.0, wall0=100.0)
+    _write_stream(d, 1, clock0=0.0, wall0=100.0, start_delay=0.05)
+    _write_stream(d, 2, clock0=500.0, wall0=100.1, n_chunks=0)
+    agg = igg.aggregate_flight(d)
+    assert agg["processes"] == [0, 1, 2]
+    assert agg["per_process"][2]["chunks"] == 0
+    assert agg["align"]["method"][2] == "wall-anchor"
+    rep = igg.straggler_report(agg)
+    assert rep["processes"] == [0, 1]  # the dead stream has no arrivals
+    assert rep["summary"]["chunks"] == 6
+    assert 2 not in rep["imbalance"]
+    # the trace still renders all three tracks (the dead process's
+    # run_begin instant is evidence of WHEN it died)
+    doc = igg.export_chrome_trace(agg)
+    assert sorted(doc["otherData"]["processes"]) == [0, 1, 2]
+
+
 # ---------------------------------------------------------------------------
 # export_chrome_trace
 # ---------------------------------------------------------------------------

@@ -110,7 +110,12 @@ def test_unsupervised_equivalence_and_reports(tmp_path):
     assert all(r.rms["T"] > 0 for r in reports)
 
 
+@pytest.mark.slow
 def test_health_counters_record_and_reset(tmp_path):
+    """Full-run counter sweep (slow: one extra supervised run+compile).
+    The fast tier keeps the shim/reset contract in
+    test_telemetry.py::test_health_counters_shim_over_registry and the
+    per-path counter asserts inside the fault-matrix tests."""
     igg.reset_health_counters()
     _init()
     step, state = _diffusion_step()
@@ -138,7 +143,17 @@ def test_terminal_checkpoint_saved_off_cadence(tmp_path):
     st, at, fellback = _CheckpointSlots(str(tmp_path / "ck")).restore()
     assert at == 12 and not fellback
     assert np.array_equal(np.asarray(st["T"]), np.asarray(out["T"]))
-    # on-cadence end: exactly one save at the final step, not two
+
+
+@pytest.mark.slow
+def test_terminal_checkpoint_on_cadence_single_save(tmp_path):
+    """On-cadence end: exactly one save at the final step, not two (the
+    complement of the off-cadence regression above; slow: a second full
+    run+compile for one counter assert)."""
+    from implicitglobalgrid_tpu.runtime.driver import _CheckpointSlots
+
+    _init()
+    step, state = _diffusion_step()
     igg.reset_health_counters()
     out, reports = igg.run_resilient(
         step, dict(state), 10, nt_chunk=5, key="resil_final2",
@@ -234,6 +249,7 @@ def test_process_loss_elastic_restart_identical(tmp_path):
 
 
 @pytest.mark.faults
+@pytest.mark.slow
 def test_nan_after_elastic_restart_rolls_back_on_new_grid(tmp_path):
     """Compound failure: process loss at 13 (elastic restart to (1,2,2)),
     then SDC at 14 — the rollback after the restart must restore onto the
@@ -277,7 +293,12 @@ def test_checkpoint_corruption_falls_back_to_other_slot(tmp_path):
 
 @pytest.mark.faults
 @pytest.mark.parametrize("kind,target", [
-    ("truncate", "shard"), ("delete", "shard"), ("bitflip", "meta"),
+    # one fast representative of the both-slots-fatal path; the other
+    # corruption flavors ride the slow tier (identical driver path,
+    # different blockio damage — each is a full faulted run+compile)
+    pytest.param("truncate", "shard", marks=pytest.mark.slow),
+    ("delete", "shard"),
+    pytest.param("bitflip", "meta", marks=pytest.mark.slow),
 ])
 def test_corruption_matrix_both_slots_fatal(tmp_path, kind, target):
     """Corrupting EVERY slot (here: the only save) must end in a clean
