@@ -47,7 +47,7 @@ def main() -> None:
 
     import implicitglobalgrid_tpu as igg
     from implicitglobalgrid_tpu.models import (
-        init_acoustic3d, init_diffusion2d, init_diffusion3d, make_run,
+        init_acoustic3d, init_diffusion2d, init_diffusion3d,
         run_acoustic, run_diffusion, run_stokes, init_stokes3d,
     )
 
@@ -185,6 +185,15 @@ def main() -> None:
     for row in bench_perf.run_model_ratio(dims3, cpu):
         results.append(bench_util.emit(row))
 
+    # --- static analysis: compile-time audit overhead ----------------------
+    # run_resilient(audit=True)'s one-time trace+lower+parse+check cost as
+    # a fraction of run time; target < 2% (ISSUE 7). Config owned by
+    # `bench_audit.run_audit_overhead` (shared with the standalone bench).
+    import bench_audit
+
+    for row in bench_audit.run_audit_overhead(dims3, cpu):
+        results.append(bench_util.emit(row))
+
     # --- pseudo-transient Stokes 3-D (BASELINE config 5) -------------------
     nxs, nts = (24, 20) if cpu else (128, 300)
     igg.init_global_grid(nxs, nxs, nxs, dimx=dims3[0], dimy=dims3[1],
@@ -197,13 +206,36 @@ def main() -> None:
            _rate(cells, nts, t) / n_chips, "cell-updates/s/chip")
     igg.finalize_global_grid()
 
+    # --- repo lint gate: `ruff check .` travels with the perf gates --------
+    # (ISSUE 7) the [tool.ruff] config in pyproject.toml is the contract;
+    # value 1 = clean tree, 0 = findings (a direct gate: rc 1 under
+    # IGG_BENCH_STRICT=1, same contract as the perfdb gate below).
+    # Containers without ruff record the row as skipped instead of
+    # vacuously passing.
+    import os
+    import subprocess
+
+    lint = subprocess.run(
+        [sys.executable, "-m", "ruff", "check", "."],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    ruff_missing = lint.returncode != 0 and "No module named" in lint.stderr
+    results.append(bench_util.emit({
+        "metric": "lint_ok",
+        "value": None if ruff_missing else (1.0 if lint.returncode == 0
+                                            else 0.0),
+        "unit": "bool (1 = `python -m ruff check .` clean)",
+        **({"note": "ruff unavailable in this environment; row skipped"}
+           if ruff_missing else
+           {} if lint.returncode == 0 else
+           {"findings": lint.stdout.strip().splitlines()[-20:]}),
+    }))
+
     # --- perf-history gate: the bench trajectory checks itself -------------
     # current run vs the trailing PERF_HISTORY.jsonl window (checked
     # BEFORE appending, so a run never gates against itself); the verdict
     # rides BENCH_ALL.json as its own row. Exit-0-with-recorded-failure is
     # the bench contract; IGG_BENCH_STRICT=1 turns a regression into rc=1.
-    import os
-
     from implicitglobalgrid_tpu.telemetry import perfdb_add, perfdb_check
 
     hist = "PERF_HISTORY.jsonl"
@@ -221,7 +253,9 @@ def main() -> None:
 
     with open("BENCH_ALL.json", "w") as f:
         json.dump(results, f, indent=1)
-    if not gate["ok"] and os.environ.get("IGG_BENCH_STRICT") == "1":
+    lint_failed = not ruff_missing and lint.returncode != 0
+    if (not gate["ok"] or lint_failed) \
+            and os.environ.get("IGG_BENCH_STRICT") == "1":
         sys.exit(1)
 
 

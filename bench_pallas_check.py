@@ -14,7 +14,6 @@ has no non-interpret pallas); only the TPU run proves Mosaic lowering.
 
 from __future__ import annotations
 
-import json
 import sys
 import traceback
 

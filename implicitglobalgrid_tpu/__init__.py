@@ -82,6 +82,12 @@ from .io import (
     SnapshotWriter, write_snapshot, open_snapshot, list_snapshots,
     Probe, AxisSlice, Stats,
 )
+from . import analysis
+from .analysis import (
+    AuditFinding, AuditReport, CollectiveContract, ProgramIR,
+    audit_model, audit_program, check_contract, exchange_contract,
+    model_contract, parse_program,
+)
 from .utils import exceptions
 
 __version__ = "0.1.0"
@@ -124,6 +130,11 @@ __all__ = [
     # io (sharded snapshot & in-situ analysis pipeline)
     "io", "SnapshotWriter", "write_snapshot", "open_snapshot",
     "list_snapshots", "Probe", "AxisSlice", "Stats",
+    # static analysis (compiled-program parser, collective contracts,
+    # implicit-grid lints, audit entry points)
+    "analysis", "ProgramIR", "parse_program", "AuditFinding",
+    "AuditReport", "CollectiveContract", "exchange_contract",
+    "model_contract", "check_contract", "audit_program", "audit_model",
     "d_xa", "d_ya", "d_za", "d_xi", "d_yi", "d_zi", "inn",
     "stochastic_round_bf16",
     # state/introspection

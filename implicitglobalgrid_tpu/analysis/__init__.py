@@ -1,0 +1,48 @@
+"""Static analysis of compiled programs (the ISSUE-7 tentpole).
+
+The framework's core claim — the reference's pack/Isend/Irecv/unpack
+machinery collapses into exactly one `collective-permute` pair per
+exchanging mesh axis, and the guard/reducer machinery into exactly one
+psum per chunk — is enforced here as a first-class subsystem instead of
+per-test regexes:
+
+- `hlo` — `parse_program`/`parse_text`: optimized HLO and StableHLO text
+  -> `ProgramIR` (full op inventory, every collective with shapes,
+  dtypes, bytes-on-wire, source-target/replica-group metadata, def-use
+  closure queries). Stdlib+numpy only: golden fixtures parse host-only.
+- `contracts` — `CollectiveContract` derived automatically from the
+  static wire plan (`halo_comm_plan` + `STEP_WORKLOADS` exchange rounds +
+  grid topology routes) and `check_contract` verifying a parsed program
+  against it; `perfmodel_crosscheck` proves `predict_step`'s collective
+  pricing equals what the compiler emitted.
+- `lints` — implicit-global-grid hazard rules: global-shape
+  materialization, missing wire downcasts, unaliased donations, host
+  transfers in the chunk body, opaque custom-calls, f64 leakage, copies
+  staging collective payloads.
+- `audit` — `audit_program` / `audit_model` / `audit_chunk_program`: the
+  wiring the tests, the ``tools audit`` CLI, and
+  `run_resilient(audit=True)` call.
+"""
+
+from .audit import (
+    AuditReport, audit_chunk_program, audit_model, audit_program,
+)
+from .contracts import (
+    AuditFinding, CollectiveContract, axis_routes, check_contract,
+    exchange_contract, guard_contract, measure_axes, model_contract,
+    perfmodel_crosscheck,
+)
+from .hlo import HloOp, ProgramIR, Shape, parse_program, parse_text
+from .lints import (
+    DEFAULT_LINTS, LINT_RULES, LintConfig, default_lint_config, run_lints,
+)
+
+__all__ = [
+    "Shape", "HloOp", "ProgramIR", "parse_text", "parse_program",
+    "AuditFinding", "CollectiveContract", "axis_routes", "measure_axes",
+    "exchange_contract", "model_contract", "guard_contract",
+    "check_contract", "perfmodel_crosscheck",
+    "LintConfig", "default_lint_config", "run_lints", "LINT_RULES",
+    "DEFAULT_LINTS",
+    "AuditReport", "audit_program", "audit_model", "audit_chunk_program",
+]

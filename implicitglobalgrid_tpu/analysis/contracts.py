@@ -1,0 +1,562 @@
+"""Collective contracts: the static wire plan as a checkable declaration.
+
+The framework's wire claims are *derivable*, not hand-counted: the halo
+layer's `halo_comm_plan` already prices every exchange from shapes,
+overlaps, and the wire dtype alone, and the perf oracle's `STEP_WORKLOADS`
+records how each model's step actually groups its exchange rounds. This
+module turns those same inputs into a `CollectiveContract` — per-axis
+expected permute counts, on-wire dtypes, exact wire bytes, legal routes
+(``source_target_pairs`` per mesh axis from the grid topology), payload
+slab bounds, and the guard psum shape — and `check_contract` verifies a
+parsed `ProgramIR` against it, yielding structured `AuditFinding`s instead
+of regex assertion failures.
+
+Because the contract and `telemetry.predict_step` price from the SAME
+plan, `perfmodel_crosscheck` closes the loop: the oracle's priced
+ppermute-pair and wire-byte counts must equal what the compiler actually
+emitted — static-model drift becomes a caught finding, not a silent
+mispricing.
+
+Route attribution: JAX lowers ``lax.ppermute`` over a mesh axis to
+``source_target_pairs`` in linearized mesh positions (row-major over
+``gg.dims``), independent of the physical device assignment, so the legal
+pair-sets per (axis, direction) are computed from ``_perm_pairs`` + the
+dims alone (`axis_routes`). A permute whose pair set matches no axis is an
+error finding by itself — an unplanned communication route.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from ..utils.exceptions import InvalidArgumentError
+from .hlo import ProgramIR
+
+__all__ = ["AuditFinding", "CollectiveContract", "axis_routes",
+           "measure_axes", "exchange_contract", "model_contract",
+           "guard_contract", "check_contract", "perfmodel_crosscheck"]
+
+SEV_ERROR, SEV_WARNING, SEV_INFO = "error", "warning", "info"
+_SEV_ORDER = {SEV_ERROR: 0, SEV_WARNING: 1, SEV_INFO: 2}
+
+
+@dataclass(frozen=True)
+class AuditFinding:
+    """One structured audit result (a broken rule, or a notable fact)."""
+
+    rule: str
+    severity: str             # "error" | "warning" | "info"
+    message: str
+    op: str | None = None     # SSA name of the op the finding anchors to
+    computation: str | None = None
+    details: dict = dc_field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        out = {"rule": self.rule, "severity": self.severity,
+               "message": self.message}
+        if self.op is not None:
+            out["op"] = self.op
+        if self.computation is not None:
+            out["computation"] = self.computation
+        if self.details:
+            out["details"] = self.details
+        return out
+
+
+def sort_findings(findings) -> list:
+    return sorted(findings,
+                  key=lambda f: (_SEV_ORDER.get(f.severity, 3), f.rule))
+
+
+@dataclass(frozen=True)
+class CollectiveContract:
+    """Expected collective shape of one compiled program.
+
+    ``axes`` maps mesh axis names to ``{"permutes", "wire_bytes",
+    "dtypes"}`` — the exact number of collective-permute OPS (2 per pair
+    per exchange group), the exact all-links bytes-on-wire, and the legal
+    payload dtypes for that axis; ``axes=None`` skips the per-axis checks
+    (counts/bytes/routes) while the structural ones (payload slab bound,
+    guard psum, forbidden gathers) still run. ``routes`` holds the legal
+    ``source_target_pairs`` sets per axis (`axis_routes`); ``None``
+    disables attribution. ``allreduce_payload`` is ``(dtype, length)`` of
+    the one permitted psum (the health guard's stats vector), checked on
+    every all-reduce present. ``max_payload_cells`` bounds every permute
+    payload strictly below the local block — dtype-generic (the old
+    f32-only regex skipped bf16/f16/f64 payloads entirely)."""
+
+    axes: dict | None = None
+    routes: dict | None = None
+    allreduces: int = 0
+    allreduce_payload: tuple | None = None
+    allow_all_gathers: bool = False
+    allow_all_to_alls: bool = False
+    max_payload_cells: int | None = None
+    meta: dict = dc_field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "axes": self.axes,
+            "routes": None if self.routes is None else {
+                a: [sorted(list(p) for p in r) for r in routes]
+                for a, routes in self.routes.items()},
+            "allreduces": self.allreduces,
+            "allreduce_payload": (list(self.allreduce_payload)
+                                  if self.allreduce_payload else None),
+            "allow_all_gathers": self.allow_all_gathers,
+            "allow_all_to_alls": self.allow_all_to_alls,
+            "max_payload_cells": self.max_payload_cells,
+            "meta": self.meta,
+        }
+
+    @classmethod
+    def from_json(cls, rec) -> "CollectiveContract":
+        if isinstance(rec, (str, bytes)):
+            rec = json.loads(rec)
+        try:
+            axes = rec.get("axes")
+            if axes is not None:
+                axes = {str(a): {"permutes": int(v["permutes"]),
+                                 "wire_bytes": (None if v.get("wire_bytes")
+                                                is None
+                                                else int(v["wire_bytes"])),
+                                 "dtypes": tuple(v.get("dtypes", ()))}
+                        for a, v in axes.items()}
+            routes = rec.get("routes")
+            if routes is not None:
+                routes = {str(a): tuple(
+                    frozenset((int(s), int(t)) for s, t in route)
+                    for route in rts) for a, rts in routes.items()}
+            arp = rec.get("allreduce_payload")
+            return cls(
+                axes=axes, routes=routes,
+                allreduces=int(rec.get("allreduces", 0)),
+                allreduce_payload=(None if arp is None
+                                   else (str(arp[0]), int(arp[1]))),
+                allow_all_gathers=bool(rec.get("allow_all_gathers", False)),
+                allow_all_to_alls=bool(rec.get("allow_all_to_alls", False)),
+                max_payload_cells=(None if rec.get("max_payload_cells")
+                                   is None
+                                   else int(rec["max_payload_cells"])),
+                meta=dict(rec.get("meta", {})))
+        except (KeyError, TypeError, ValueError, IndexError) as e:
+            raise InvalidArgumentError(
+                f"CollectiveContract.from_json: malformed record ({e}).") \
+                from e
+
+
+# ---------------------------------------------------------------------------
+# topology-derived route tables
+
+def axis_routes(gg=None) -> dict:
+    """Legal directed ``(source, target)`` pair-sets per mesh axis and
+    exchange direction, in linearized mesh positions (row-major over
+    ``gg.dims`` — the ids JAX emits in ``source_target_pairs``)."""
+    from ..ops.halo import _perm_pairs
+    from ..parallel.topology import AXIS_NAMES, global_grid
+
+    gg = gg if gg is not None else global_grid()
+    dims = [int(d) for d in gg.dims]
+    table: dict = {}
+    for d, axis in enumerate(AXIS_NAMES):
+        D, periodic, disp = dims[d], bool(gg.periods[d]), int(gg.disp)
+        perm_p, perm_m = _perm_pairs(D, periodic, disp)
+        routes = []
+        for perm in (perm_p, perm_m):
+            pairs = set()
+            spaces = [range(dims[k]) if k != d else (0,)
+                      for k in range(len(dims))]
+            for base in itertools.product(*spaces):
+                for s, t in perm:
+                    if s == t:  # periodic self-neighbor: local copy, no wire
+                        continue
+                    src, dst = list(base), list(base)
+                    src[d], dst[d] = s, t
+                    pairs.add((int(np.ravel_multi_index(src, dims)),
+                               int(np.ravel_multi_index(dst, dims))))
+            if pairs:
+                routes.append(frozenset(pairs))
+        if routes:
+            table[axis] = tuple(routes)
+    return table
+
+
+def attribute_axis(routes: dict, pairs) -> str | None:
+    """Mesh axis whose legal route matches the permute's pair set."""
+    ps = frozenset((int(s), int(t)) for s, t in pairs)
+    for axis, rts in routes.items():
+        if ps in rts:
+            return axis
+    return None
+
+
+def measure_axes(ir: ProgramIR, routes: dict) -> dict:
+    """Per-axis totals of the parsed program's permutes: op count, directed
+    pair count, all-links wire bytes, payload dtypes. Unattributable
+    permutes land under the ``None`` key."""
+    out: dict = {}
+    for op in ir.permutes:
+        pairs = op.attrs.get("source_target_pairs") or ()
+        axis = attribute_axis(routes, pairs) if pairs else None
+        rec = out.setdefault(axis, {"permutes": 0, "pairs": 0,
+                                    "wire_bytes": 0, "dtypes": set()})
+        rec["permutes"] += 1
+        rec["pairs"] += len(pairs)
+        rec["wire_bytes"] += ir.wire_bytes_of(op)
+        pay = ir.payload_of(op)
+        if pay is not None:
+            rec["dtypes"].add(pay.dtype)
+    return {a: {**r, "dtypes": tuple(sorted(r["dtypes"]))}
+            for a, r in out.items()}
+
+
+# ---------------------------------------------------------------------------
+# contract derivation (from the SAME plan the telemetry layer prices)
+
+_NP_TO_HLO = {
+    "float64": "f64", "float32": "f32", "float16": "f16",
+    "bfloat16": "bf16", "bool": "pred",
+    "int8": "s8", "int16": "s16", "int32": "s32", "int64": "s64",
+    "uint8": "u8", "uint16": "u16", "uint32": "u32", "uint64": "u64",
+    "complex64": "c64", "complex128": "c128",
+}
+
+
+def hlo_dtype(name) -> str:
+    """Numpy/jax dtype spelling -> HLO spelling (``float32`` -> ``f32``);
+    HLO spellings pass through unchanged."""
+    return _NP_TO_HLO.get(str(name), str(name))
+
+
+def _merged_plan(fields, rounds, *, dims=None, coalesce=None,
+                 wire_dtype=None) -> dict:
+    """Per-axis {ppermutes, wire_bytes, dtypes} merged over the exchange
+    rounds exactly as `telemetry.predict_step` merges them: fields in one
+    round coalesce, separate rounds pay separate permutes.
+
+    ``wire_bytes`` here is the ALL-LINKS total the parser measures in a
+    compiled program (`ProgramIR.wire_bytes_of` sums the payload over
+    every ``source_target_pairs`` entry). `halo_comm_plan` prices one
+    axis LINE — payload x directed pairs along a single line of shards —
+    while the compiled permute's pair list enumerates every parallel
+    line of the mesh, so each axis scales by the perpendicular line
+    count (total shards / that axis's extent). Dtypes are converted to
+    HLO spelling to match the parsed payloads."""
+    from ..ops.halo import halo_comm_plan
+    from ..parallel.topology import AXIS_NAMES, global_grid
+
+    gg = global_grid()
+    gdims = [int(d) for d in gg.dims]
+    total = 1
+    for d in gdims:
+        total *= d
+    axis_dim = {a: i for i, a in enumerate(AXIS_NAMES)}
+    fields = tuple(fields)
+    merged: dict = {}
+    for group in rounds:
+        if any(i >= len(fields) for i in group):
+            raise InvalidArgumentError(
+                f"exchange round {tuple(group)} indexes past the "
+                f"{len(fields)} given fields.")
+        sub = halo_comm_plan(*(fields[i] for i in group), dims=dims,
+                             coalesce=coalesce, wire_dtype=wire_dtype)
+        for axis, rec in sub["axes"].items():
+            n_lines = total // gdims[axis_dim[axis]]
+            dst = merged.setdefault(
+                axis, {"permutes": 0, "wire_bytes": 0, "dtypes": set()})
+            dst["permutes"] += int(rec["ppermutes"])
+            dst["wire_bytes"] += int(rec["wire_bytes"]) * n_lines
+            dst["dtypes"].update(hlo_dtype(d) for d in rec["by_dtype"])
+    return merged
+
+
+def _local_block_cells(fields) -> int:
+    """Total per-shard block cells across the stacked fields — the slab
+    bound: every permute payload must be strictly smaller. A coalesced
+    payload legitimately aggregates N fields' slabs (N x slab can reach
+    one field's block), so the structural bound is the whole group's
+    block total; the per-axis ``wire_bytes`` equality pins the EXACT slab
+    sizes whenever the contract carries axes."""
+    from ..ops.halo import _normalized_fields, _stacked_sig
+    from ..parallel.topology import global_grid
+
+    gg = global_grid()
+    sig = _stacked_sig(gg, _normalized_fields(fields))
+    return sum(int(np.prod(shape)) for shape, _, _ in sig)
+
+
+def exchange_contract(*fields, rounds=None, dims=None, coalesce=None,
+                      wire_dtype=None, guard_floats: int | None = None,
+                      meta=None) -> CollectiveContract:
+    """Derive the contract for an exchange (or a step program) over the
+    CURRENT grid from the static wire plan alone.
+
+    ``fields`` take the same forms as `halo_comm_plan` (arrays, `Field`,
+    ``(A, hw)`` tuples, ``jax.ShapeDtypeStruct``). ``rounds`` lists the
+    exchange rounds as tuples of field indices (default: one coalesced
+    round of every field — `STEP_WORKLOADS[...].exchange_groups` for a
+    model step). ``guard_floats`` adds the resilient runtime's psum
+    expectation: exactly one f32 all-reduce of that many floats."""
+    from ..parallel.topology import check_initialized, global_grid
+
+    check_initialized()
+    gg = global_grid()
+    rounds = rounds if rounds is not None else (tuple(range(len(fields))),)
+    merged = _merged_plan(fields, rounds, dims=dims, coalesce=coalesce,
+                          wire_dtype=wire_dtype)
+    axes = {a: {"permutes": r["permutes"], "wire_bytes": r["wire_bytes"],
+                "dtypes": tuple(sorted(r["dtypes"]))}
+            for a, r in merged.items() if r["permutes"]}
+    return CollectiveContract(
+        axes=axes,
+        routes=axis_routes(gg),
+        allreduces=0 if guard_floats is None else 1,
+        allreduce_payload=(None if guard_floats is None
+                           else ("f32", int(guard_floats))),
+        max_payload_cells=_local_block_cells(fields),
+        meta=dict(meta or {}, dims=[int(d) for d in gg.dims],
+                  periods=[int(p) for p in gg.periods]))
+
+
+def model_contract(model, fields, *, dims=None, coalesce=None,
+                   wire_dtype=None,
+                   guard_floats: int | None = None) -> CollectiveContract:
+    """The step contract of a model family: exchange rounds from
+    `telemetry.STEP_WORKLOADS[model].exchange_groups`, priced over the
+    model's state ``fields`` (canonical state order)."""
+    from ..telemetry.perfmodel import STEP_WORKLOADS
+
+    work = STEP_WORKLOADS.get(str(model))
+    if work is None:
+        raise InvalidArgumentError(
+            f"model_contract: unknown model {model!r} "
+            f"(have {sorted(STEP_WORKLOADS)}).")
+    return exchange_contract(
+        *fields, rounds=work.exchange_groups, dims=dims, coalesce=coalesce,
+        wire_dtype=wire_dtype, guard_floats=guard_floats,
+        meta={"model": str(model)})
+
+
+def guard_contract(n_fields: int, reducer_floats: int = 0,
+                   meta=None) -> CollectiveContract:
+    """The resilient chunk program's structural contract when the step
+    body is user code (per-axis permute counts unknowable): exactly one
+    f32[2N + R] guard psum, no gathers, no all-to-alls."""
+    return CollectiveContract(
+        axes=None, routes=None, allreduces=1,
+        allreduce_payload=("f32", 2 * int(n_fields) + int(reducer_floats)),
+        meta=dict(meta or {}, n_fields=int(n_fields),
+                  reducer_floats=int(reducer_floats)))
+
+
+# ---------------------------------------------------------------------------
+# the checker
+
+def check_contract(ir: ProgramIR, contract: CollectiveContract) -> list:
+    """Verify a parsed program against a contract. Returns findings
+    (empty list = the program honors the contract)."""
+    if not isinstance(ir, ProgramIR):
+        raise InvalidArgumentError(
+            "check_contract expects a ProgramIR (use parse_program).")
+    if contract.axes and contract.routes is None:
+        # without routes no permute can be attributed to an axis, so every
+        # per-axis expectation would "fail" with got=0 on a conforming
+        # program — an unsatisfiable contract is a caller error, not a
+        # finding (hand-written JSON contracts: include "routes", or use
+        # axis_routes() on the live grid)
+        raise InvalidArgumentError(
+            "check_contract: a contract with per-axis expectations needs "
+            "routes to attribute permutes (axis_routes(), or a 'routes' "
+            "table in the contract JSON).")
+    findings: list = []
+    routes = contract.routes
+    per_axis: dict = {a: {"permutes": 0, "wire_bytes": 0, "dtypes": set()}
+                      for a in (contract.axes or {})}
+
+    for op in ir.permutes:
+        pay = ir.payload_of(op)
+        pairs = op.attrs.get("source_target_pairs") or ()
+        if contract.max_payload_cells is not None and pay is not None \
+                and pay.cells >= contract.max_payload_cells:
+            findings.append(AuditFinding(
+                "permute-payload", SEV_ERROR,
+                f"collective-permute payload {pay} is not slab-sized "
+                f"(>= the {contract.max_payload_cells}-cell local block): "
+                "XLA failed to fuse the slab slicing.",
+                op=op.name, computation=op.computation,
+                details={"payload": str(pay), "cells": pay.cells}))
+        if routes is None:
+            continue
+        axis = attribute_axis(routes, pairs) if pairs else None
+        if axis is None:
+            findings.append(AuditFinding(
+                "permute-route", SEV_ERROR,
+                "collective-permute rides a route matching no mesh axis "
+                "of the static plan (unplanned communication).",
+                op=op.name, computation=op.computation,
+                details={"source_target_pairs": [list(p) for p in pairs]}))
+            continue
+        if contract.axes is not None and axis not in contract.axes:
+            findings.append(AuditFinding(
+                "permute-count", SEV_ERROR,
+                f"collective-permute on mesh axis {axis!r}, which the "
+                "plan expects not to exchange.",
+                op=op.name, computation=op.computation,
+                details={"axis": axis}))
+            continue
+        if axis in per_axis:
+            per_axis[axis]["permutes"] += 1
+            per_axis[axis]["wire_bytes"] += ir.wire_bytes_of(op)
+            if pay is not None:
+                per_axis[axis]["dtypes"].add(pay.dtype)
+
+    if contract.axes is not None:
+        for axis, exp in contract.axes.items():
+            got = per_axis.get(axis,
+                               {"permutes": 0, "wire_bytes": 0,
+                                "dtypes": set()})
+            if got["permutes"] != int(exp["permutes"]):
+                findings.append(AuditFinding(
+                    "permute-count", SEV_ERROR,
+                    f"axis {axis!r}: {got['permutes']} collective-permutes "
+                    f"in the program, plan expects {exp['permutes']}.",
+                    details={"axis": axis, "got": got["permutes"],
+                             "expected": int(exp["permutes"])}))
+                continue
+            exp_bytes = exp.get("wire_bytes")
+            if exp_bytes is not None and got["wire_bytes"] != int(exp_bytes):
+                findings.append(AuditFinding(
+                    "wire-bytes", SEV_ERROR,
+                    f"axis {axis!r}: {got['wire_bytes']} bytes on wire in "
+                    f"the program, plan prices {exp_bytes}.",
+                    details={"axis": axis, "got": got["wire_bytes"],
+                             "expected": int(exp_bytes)}))
+            exp_dts = set(exp.get("dtypes") or ())
+            if exp_dts and not set(got["dtypes"]) <= exp_dts:
+                findings.append(AuditFinding(
+                    "permute-dtype", SEV_ERROR,
+                    f"axis {axis!r}: payload dtypes "
+                    f"{sorted(got['dtypes'])} not within the plan's "
+                    f"{sorted(exp_dts)} (wire-dtype contract).",
+                    details={"axis": axis,
+                             "got": sorted(got["dtypes"]),
+                             "expected": sorted(exp_dts)}))
+
+    ars = ir.all_reduces
+    if len(ars) != int(contract.allreduces):
+        findings.append(AuditFinding(
+            "allreduce-count", SEV_ERROR,
+            f"{len(ars)} all-reduces in the program, contract expects "
+            f"{contract.allreduces}.",
+            details={"got": len(ars), "expected": int(contract.allreduces)}))
+    if contract.allreduce_payload is not None:
+        dt, length = contract.allreduce_payload
+        for op in ars:
+            pay = ir.payload_of(op)
+            if pay is None or pay.dtype != dt or pay.cells != int(length):
+                findings.append(AuditFinding(
+                    "allreduce-payload", SEV_ERROR,
+                    f"all-reduce payload {pay} is not the guard's tiny "
+                    f"{dt}[{length}] stats vector.",
+                    op=op.name, computation=op.computation,
+                    details={"payload": str(pay) if pay else None,
+                             "expected": f"{dt}[{length}]"}))
+    if ir.all_gathers and not contract.allow_all_gathers:
+        findings.append(AuditFinding(
+            "all-gather-forbidden", SEV_ERROR,
+            f"{len(ir.all_gathers)} all-gather(s) in a program whose "
+            "contract forbids them (a gather over the implicit grid "
+            "materializes what must never exist).",
+            details={"got": len(ir.all_gathers)}))
+    if ir.all_to_alls and not contract.allow_all_to_alls:
+        findings.append(AuditFinding(
+            "all-to-all-forbidden", SEV_ERROR,
+            f"{len(ir.all_to_alls)} all-to-all(s) in a program whose "
+            "contract forbids them.",
+            details={"got": len(ir.all_to_alls)}))
+    return sort_findings(findings)
+
+
+# ---------------------------------------------------------------------------
+# perfmodel cross-check
+
+def perfmodel_crosscheck(model, fields, ir: ProgramIR, *, profile=None,
+                         dims=None, coalesce=None, wire_dtype=None) -> dict:
+    """Prove `telemetry.predict_step`'s collective pricing against the
+    compiled program: per mesh axis, the oracle's priced ppermute PAIRS
+    and all-links wire bytes must equal what the parser measured in the
+    program. Returns ``{"ok", "findings", "axes"}`` where each axis entry
+    carries modeled vs parsed numbers — drift in the static model becomes
+    a caught ``perfmodel-drift`` finding instead of silent mispricing."""
+    from ..parallel.topology import check_initialized, global_grid
+    from ..telemetry.perfmodel import predict_step
+
+    check_initialized()
+    gg = global_grid()
+    pred = predict_step(model, fields, profile=profile, dims=dims,
+                        coalesce=coalesce, wire_dtype=wire_dtype)
+    plan = _merged_plan(fields,
+                        _exchange_rounds(model, len(fields)),
+                        dims=dims, coalesce=coalesce, wire_dtype=wire_dtype)
+    parsed = measure_axes(ir, axis_routes(gg))
+    findings: list = []
+    axes: dict = {}
+    for axis in sorted(set(plan) | set(k for k in parsed if k is not None)):
+        modeled_pairs = pred["comm"].get(axis, {}).get("ppermute_pairs", 0.0)
+        modeled_bytes = plan.get(axis, {}).get("wire_bytes", 0)
+        # the pairs come from predict_step (the oracle under test), the
+        # all-links bytes from this module's round merge — the two price
+        # the SAME rounds from the SAME plan, so a disagreement between
+        # them means one merge loop was edited without the other: flag it
+        # rather than crosscheck against a self-inconsistent model
+        plan_pairs = plan.get(axis, {}).get("permutes", 0) / 2.0
+        if plan_pairs != modeled_pairs:
+            findings.append(AuditFinding(
+                "model-inconsistent", SEV_ERROR,
+                f"axis {axis!r}: predict_step prices {modeled_pairs} "
+                f"ppermute pairs but the plan merge counts {plan_pairs} "
+                "— the model's two round-merge paths have diverged "
+                "(fix telemetry.perfmodel / analysis.contracts before "
+                "trusting the crosscheck).",
+                details={"axis": axis, "predict_step_pairs": modeled_pairs,
+                         "plan_pairs": plan_pairs}))
+        got = parsed.get(axis, {"permutes": 0, "wire_bytes": 0})
+        got_pairs = got["permutes"] / 2.0
+        axes[axis] = {"modeled_pairs": modeled_pairs,
+                      "parsed_pairs": got_pairs,
+                      "modeled_wire_bytes": int(modeled_bytes),
+                      "parsed_wire_bytes": int(got["wire_bytes"])}
+        if got_pairs != modeled_pairs \
+                or int(got["wire_bytes"]) != int(modeled_bytes):
+            findings.append(AuditFinding(
+                "perfmodel-drift", SEV_ERROR,
+                f"axis {axis!r}: predict_step prices "
+                f"{modeled_pairs} ppermute pairs / {modeled_bytes} wire "
+                f"bytes per step, the compiled program carries "
+                f"{got_pairs} / {got['wire_bytes']} — the static cost "
+                "model has drifted from what the compiler emits.",
+                details=axes[axis]))
+    if None in parsed:
+        findings.append(AuditFinding(
+            "permute-route", SEV_ERROR,
+            f"{parsed[None]['permutes']} collective-permute(s) ride "
+            "routes matching no mesh axis — unpriceable by the model.",
+            details=parsed[None]))
+    return {"ok": not findings, "findings": findings, "axes": axes,
+            "model": str(model), "profile_source": pred["profile_source"]}
+
+
+def _exchange_rounds(model, n_fields: int):
+    from ..telemetry.perfmodel import STEP_WORKLOADS, StepWorkload
+
+    if isinstance(model, StepWorkload):
+        return model.exchange_groups
+    work = STEP_WORKLOADS.get(str(model))
+    if work is None:
+        raise InvalidArgumentError(
+            f"unknown model {model!r} (have {sorted(STEP_WORKLOADS)}).")
+    return work.exchange_groups

@@ -1,0 +1,273 @@
+"""Implicit-global-grid lint rules over a parsed program.
+
+Where `contracts` checks the *planned* collective shape, these rules hunt
+for the hazards no plan mentions — things a compiled hot-path program of
+this framework must never contain:
+
+- ``global-materialization`` — an op shaped like the IMPLICIT GLOBAL grid
+  (``nxyz_g``): the array whose whole point is to never exist. Post-SPMD
+  programs only hold per-shard blocks, so any global-shaped buffer is a
+  partitioning failure (optimized-HLO dialect only: the pre-partitioning
+  StableHLO module legitimately carries stacked arrays at the jit
+  boundary).
+- ``wire-downcast-missing`` — a reduced-precision wire dtype was
+  requested but no permute payload carries it (the narrowing silently
+  didn't happen). Meaningful on the LOWERED module for CPU runs — the
+  XLA:CPU float-normalization pass rewrites bf16 payloads back to f32 in
+  backend-optimized text; TPU keeps them native.
+- ``donation-unaliased`` — fewer input-output aliases in the module
+  header than donated inputs: each missing alias is a hidden full-block
+  copy per dispatch.
+- ``host-transfer`` — infeed/outfeed/send/recv/host callbacks inside the
+  program: a device<->host round-trip in the chunk body serializes the
+  step loop.
+- ``custom-call`` — opaque custom-calls (partitioner markers and other
+  benign targets excluded): the scheduler can't reason about them.
+- ``f64-leakage`` — f64 buffers in a program whose state dtypes don't
+  include f64 (on TPU every f64 op runs through emulation several-x
+  slower; an unintended promotion is a silent perf cliff).
+- ``copy-feeds-collective`` — a ``copy`` op feeding a collective operand:
+  the slab slicing failed to fuse and the wire payload is staged through
+  an extra buffer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..utils.exceptions import InvalidArgumentError
+from .contracts import AuditFinding, SEV_ERROR, SEV_WARNING, sort_findings
+from .hlo import ProgramIR, Shape
+
+__all__ = ["LintConfig", "default_lint_config", "run_lints", "LINT_RULES",
+           "DEFAULT_LINTS"]
+
+# custom-call targets that are partitioning/sharding machinery, not opaque
+# compute — present in every shard_map program by construction
+_BENIGN_CUSTOM_CALLS = {
+    "Sharding", "SPMDFullToShardShape", "SPMDShardToFullShape",
+    "MoveToHost", "MoveToDevice", "AllocateBuffer", "xla.sdy.FuncResultSharding",
+}
+# host-callback custom-call targets: a device->host round trip per call
+_HOST_CALLBACK_TARGETS = {
+    "xla_python_cpu_callback", "xla_python_gpu_callback",
+    "xla_ffi_python_cpu_callback", "xla_ffi_python_gpu_callback",
+    "xla_ffi_partitioned_python_cpu_callback", "tpu_host_callback",
+}
+_HOST_TRANSFER_OPS = {"infeed", "outfeed", "send", "recv", "send-done",
+                      "recv-done"}
+
+
+@dataclass(frozen=True)
+class LintConfig:
+    """What the linter should consider legitimate for this program.
+
+    ``global_shape``/``local_shape`` come from the live grid (see
+    `default_lint_config`); ``state_dtypes`` are the dtypes the program's
+    state legitimately holds (f64 presence beyond these flags);
+    ``wire_dtype`` is the REQUESTED reduced-precision wire format (HLO
+    spelling, e.g. ``"bf16"``) whose absence from the wire should flag;
+    ``expect_donation`` is the number of donated inputs that must appear
+    as input-output aliases."""
+
+    global_shape: tuple | None = None
+    local_shape: tuple | None = None
+    state_dtypes: tuple = ()
+    wire_dtype: str | None = None
+    expect_donation: int | None = None
+
+
+_WIRE_NAMES = {"bfloat16": "bf16", "float16": "f16", "float32": "f32",
+               "float64": "f64"}
+
+
+def default_lint_config(grid=None, *, state_dtypes=(), wire_dtype=None,
+                        expect_donation=None) -> LintConfig:
+    """Build a config from the LIVE grid: the forbidden global shape is
+    ``nxyz_g``, the legitimate block shape ``nxyz``. ``wire_dtype``
+    accepts numpy/jax spellings (``bfloat16``) or HLO ones (``bf16``)."""
+    from ..parallel.topology import global_grid, grid_is_initialized
+
+    gshape = lshape = None
+    if grid is not None or grid_is_initialized():
+        gg = grid if grid is not None else global_grid()
+        gshape = tuple(int(n) for n in gg.nxyz_g)
+        lshape = tuple(int(n) for n in gg.nxyz)
+    wd = None
+    if wire_dtype is not None:
+        wd = str(wire_dtype)
+        wd = _WIRE_NAMES.get(wd, wd)
+    return LintConfig(
+        global_shape=gshape, local_shape=lshape,
+        state_dtypes=tuple(_WIRE_NAMES.get(str(d), str(d))
+                           for d in state_dtypes),
+        wire_dtype=wd, expect_donation=expect_donation)
+
+
+# ---------------------------------------------------------------------------
+# rules: each fn(ir, cfg) -> list[AuditFinding]
+
+def _lint_global_materialization(ir: ProgramIR, cfg: LintConfig) -> list:
+    if ir.dialect != "hlo" or cfg.global_shape is None:
+        return []  # pre-SPMD modules legitimately hold stacked arrays
+    if cfg.global_shape == cfg.local_shape:
+        return []  # single-shard grid: the block IS the global array
+    out = []
+    for op in ir.ops:
+        for s in op.shapes:
+            if s.dims == cfg.global_shape:
+                out.append(AuditFinding(
+                    "global-materialization", SEV_ERROR,
+                    f"op materializes the implicit GLOBAL grid shape {s} "
+                    "— the array this framework exists to never build.",
+                    op=op.name, computation=op.computation,
+                    details={"shape": str(s)}))
+                break
+    return out
+
+
+def _lint_wire_downcast(ir: ProgramIR, cfg: LintConfig) -> list:
+    if cfg.wire_dtype is None:
+        return []
+    permutes = ir.permutes
+    if not permutes:
+        return []
+    # EVERY float payload must be at or below the wire width — a partial
+    # regression (one axis narrowed, the others still full precision) is
+    # as real a bandwidth loss as a total one. Width, not equality: an
+    # f16 field under bf16 wire legitimately ships as f16
+    # (`wire_dtype_for` never widens a payload).
+    wire_width = Shape(cfg.wire_dtype, ()).itemsize
+    stale = [p for p in permutes
+             if (pay := ir.payload_of(p)) is not None
+             and pay.dtype.lstrip("b").startswith("f")
+             and not pay.dtype.startswith("f8")
+             and pay.itemsize > wire_width]
+    if not stale:
+        return []
+    n_float = sum(1 for p in permutes
+                  if (pay := ir.payload_of(p)) is not None
+                  and pay.dtype.lstrip("b").startswith("f"))
+    got = sorted({str(ir.payload_of(p)) for p in stale})
+    return [AuditFinding(
+        "wire-downcast-missing", SEV_ERROR,
+        f"wire dtype {cfg.wire_dtype!r} requested but {len(stale)} of "
+        f"{n_float} float collective-permute payload(s) still cross the "
+        f"link wider than it (stale payloads: {got}) — the narrowing "
+        "did not reach (all of) the wire. (Audit the LOWERED module on "
+        "CPU: its backend normalizes bf16 payloads back to f32.)",
+        details={"wire_dtype": cfg.wire_dtype, "payloads": got,
+                 "stale": len(stale), "float_permutes": n_float})]
+
+
+def _lint_donation(ir: ProgramIR, cfg: LintConfig) -> list:
+    if cfg.expect_donation is None or ir.dialect != "hlo":
+        return []
+    n = int(ir.attrs.get("n_aliases", 0))
+    if n >= int(cfg.expect_donation):
+        return []
+    return [AuditFinding(
+        "donation-unaliased", SEV_WARNING,
+        f"{cfg.expect_donation} donated input(s) but only {n} input-"
+        "output alias(es) in the module header: each missing alias is a "
+        "hidden full-block copy per dispatch.",
+        details={"expected": int(cfg.expect_donation), "aliased": n})]
+
+
+def _lint_host_transfer(ir: ProgramIR, cfg: LintConfig) -> list:
+    out = []
+    for op in ir.ops:
+        hostile = op.op in _HOST_TRANSFER_OPS \
+            or op.attrs.get("is_host_transfer") \
+            or (op.op == "custom-call"
+                and op.attrs.get("custom_call_target")
+                in _HOST_CALLBACK_TARGETS)
+        if hostile:
+            out.append(AuditFinding(
+                "host-transfer", SEV_ERROR,
+                f"{op.op} inside the compiled body "
+                f"({op.attrs.get('custom_call_target') or op.name}): a "
+                "host round-trip serializes the step loop.",
+                op=op.name, computation=op.computation))
+    return out
+
+
+def _lint_custom_call(ir: ProgramIR, cfg: LintConfig) -> list:
+    out = []
+    for op in ir.ops:
+        if op.op != "custom-call":
+            continue
+        target = op.attrs.get("custom_call_target")
+        if target in _BENIGN_CUSTOM_CALLS \
+                or target in _HOST_CALLBACK_TARGETS:
+            continue  # host callbacks are the host-transfer rule's job
+        out.append(AuditFinding(
+            "custom-call", SEV_WARNING,
+            f"opaque custom-call {target!r}: the compiler cannot fuse or "
+            "reason across it.",
+            op=op.name, computation=op.computation,
+            details={"target": target}))
+    return out
+
+
+def _lint_f64(ir: ProgramIR, cfg: LintConfig) -> list:
+    if "f64" in cfg.state_dtypes:
+        return []
+    leaks = [op for op in ir.ops if op.has_shape("f64")]
+    if not leaks:
+        return []
+    return [AuditFinding(
+        "f64-leakage", SEV_WARNING,
+        f"{len(leaks)} op(s) carry f64 buffers in a program whose state "
+        "dtypes are "
+        f"{sorted(cfg.state_dtypes) or '(unspecified)'} — on TPU every "
+        "f64 op runs through emulation; an unintended promotion is a "
+        "silent perf cliff.",
+        op=leaks[0].name, computation=leaks[0].computation,
+        details={"ops": len(leaks), "first": leaks[0].op})]
+
+
+def _lint_copy_feeds_collective(ir: ProgramIR, cfg: LintConfig) -> list:
+    out = []
+    for op in ir.collectives():
+        for name in op.operands:
+            prod = ir.resolve(op.computation, name)
+            if prod is not None and prod.op == "copy":
+                out.append(AuditFinding(
+                    "copy-feeds-collective", SEV_WARNING,
+                    f"{op.op} payload is staged through a copy "
+                    f"({prod.shapes[0] if prod.shapes else '?'}): the "
+                    "slab slicing failed to fuse into the collective.",
+                    op=op.name, computation=op.computation,
+                    details={"copy": prod.name}))
+    return out
+
+
+LINT_RULES = {
+    "global-materialization": _lint_global_materialization,
+    "wire-downcast-missing": _lint_wire_downcast,
+    "donation-unaliased": _lint_donation,
+    "host-transfer": _lint_host_transfer,
+    "custom-call": _lint_custom_call,
+    "f64-leakage": _lint_f64,
+    "copy-feeds-collective": _lint_copy_feeds_collective,
+}
+DEFAULT_LINTS = tuple(LINT_RULES)
+
+
+def run_lints(ir: ProgramIR, *, config: LintConfig | None = None,
+              rules=None) -> list:
+    """Run the lint ``rules`` (names from `LINT_RULES`; default all) over
+    a parsed program. Returns findings sorted most-severe first."""
+    if not isinstance(ir, ProgramIR):
+        raise InvalidArgumentError(
+            "run_lints expects a ProgramIR (use parse_program).")
+    config = config if config is not None else default_lint_config()
+    out: list = []
+    for name in (rules if rules is not None else DEFAULT_LINTS):
+        fn = LINT_RULES.get(name)
+        if fn is None:
+            raise InvalidArgumentError(
+                f"unknown lint rule {name!r} (have {sorted(LINT_RULES)}).")
+        out.extend(fn(ir, config))
+    return sort_findings(out)

@@ -59,7 +59,6 @@ round-trip through the wire dtype. See `ops.precision.wire_dtype_for`.
 
 from __future__ import annotations
 
-from functools import partial
 
 import numpy as np
 

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 from ..parallel.topology import check_initialized, global_grid
 from ..utils.exceptions import InvalidArgumentError
-from .halo import DEFAULT_DIMS_ORDER, _normalize_dims_order, local_update_halo
+from .halo import _normalize_dims_order, local_update_halo
 
 __all__ = ["hide_communication"]
 

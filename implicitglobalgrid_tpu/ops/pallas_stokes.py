@@ -284,7 +284,6 @@ def _stokes_kernel(*refs, nx, modes, mu, dt_v, dt_p, damp, dx, dy, dz,
 def stokes_step_exchange_pallas(state, gg, modes, p, *, interpret=False):
     """One fused PT iteration (all updates + the 4-field halo exchange) for
     arbitrary shardings. ``modes`` from `stokes_exchange_modes`."""
-    import jax
     import jax.numpy as jnp
     from jax import lax
     from jax.experimental import pallas as pl

@@ -384,7 +384,6 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
                                   dx, dy, dz, interpret=False):
     """One fused acoustic step (updates + full exchange of all four fields)
     for arbitrary shardings. ``modes`` from `wave_exchange_modes`."""
-    import jax
     import jax.numpy as jnp
     from jax import lax
     from jax.experimental import pallas as pl

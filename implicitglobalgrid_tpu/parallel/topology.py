@@ -21,7 +21,7 @@ inside `shard_map` (the analog of `MPI.Cart_coords`).
 from __future__ import annotations
 
 import copy
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass
 from typing import Any
 
 import numpy as np

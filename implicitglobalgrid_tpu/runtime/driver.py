@@ -150,7 +150,8 @@ def run_resilient(step_local, state: dict, nt: int, *,
                   metrics_port: int | None = None,
                   healthz_max_age_s: float | None = None,
                   perf_model=None, perf_window: int = 16,
-                  perf_zmax: float = 4.0):
+                  perf_zmax: float = 4.0,
+                  audit: bool = False, audit_lints=None):
     """Advance ``state`` by ``nt`` steps under health supervision with
     checkpoint-rollback recovery. Returns ``(state, reports)``.
 
@@ -211,7 +212,25 @@ def run_resilient(step_local, state: dict, nt: int, *,
     `telemetry.predict_step` record or modeled per-step seconds — which
     enables the measured/modeled ratio gauge and is echoed as a
     ``perf_model`` flight event for `run_report`'s ``"perf"`` section;
-    ``perf_window=0`` disables the detector entirely."""
+    ``perf_window=0`` disables the detector entirely.
+
+    ``audit=True`` statically audits every distinct chunk program the
+    run dispatches, each ONCE at compile time
+    (`analysis.audit_chunk_program`): each distinct chunk length is a
+    distinct jitted program (a cadence-clipped first chunk must not
+    leave the steady-state program unaudited), and an elastic restart
+    re-audits the rebuilt decomposition's programs. The runner is
+    traced+lowered and the StableHLO checked against the guard contract
+    (exactly one f32[2N + R] psum, no gathers) plus the implicit-grid
+    lints (``audit_lints`` selects rules from `analysis.LINT_RULES`;
+    default all). Host-side only — the XLA executable the run dispatches
+    is built exactly as without the audit (HLO-asserted in
+    tests/test_hlo_audit.py, gated <2% in bench_audit.py). Findings
+    stream to the flight recorder (``audit`` event — `run_report`'s
+    ``"audit"`` section) and the
+    ``igg_audit_findings_total{rule,severity}`` metric family; an
+    error-severity finding does NOT abort the run (the audit observes,
+    operators gate via the report/CLI)."""
     import numpy as np
 
     from ..parallel.topology import check_initialized
@@ -255,6 +274,21 @@ def run_resilient(step_local, state: dict, nt: int, *,
                 raise InvalidArgumentError(
                     f"NaNPoke index {tuple(f.index)} is outside field "
                     f"{f.name!r} of stacked shape {tuple(shape)}.")
+    if audit_lints is not None and not audit:
+        raise InvalidArgumentError(
+            "audit_lints selects rules for the compile-time audit — it "
+            "needs audit=True.")
+    if audit_lints is not None:
+        # fail fast on a typo'd rule name: inside the chunk loop it would
+        # only surface as a buried `audit_failed` event (the audit
+        # degrades by design), silently disabling the requested audit
+        from ..analysis import LINT_RULES
+
+        unknown = sorted(set(audit_lints) - set(LINT_RULES))
+        if unknown:
+            raise InvalidArgumentError(
+                f"audit_lints: unknown lint rule(s) {unknown}; "
+                f"available: {sorted(LINT_RULES)}.")
     # the live endpoint comes up FIRST: a port conflict must fail the call
     # before any other resource (writer thread, checkpoint dirs) spins up
     from ..telemetry.hooks import note_heartbeat, runner_cache_misses
@@ -350,6 +384,13 @@ def run_resilient(step_local, state: dict, nt: int, *,
     chunk_idx = 0
     retries = 0
     saves = 0
+    # each distinct chunk length n is a distinct jitted program (the
+    # runner cache keys on it): audit every one the run dispatches, once
+    # — a cadence-clipped first chunk must not leave the steady-state
+    # program unaudited. Failures get ONE retry at a later boundary
+    # (transient host error != permanently-broken parser).
+    audited_ns: set = set()
+    audit_fail_counts: dict = {}
 
     def _save(st, at_step):
         nonlocal saves
@@ -426,6 +467,12 @@ def run_resilient(step_local, state: dict, nt: int, *,
                 profiling.record_health_event("elastic_restarts")
                 record_event("elastic_restart",
                              new_dims=list(loss.new_dims), to_step=step)
+                # the restart rebuilds the chunk program for the NEW
+                # decomposition — audit that one too (run_report's audit
+                # section treats the last audit as authoritative), with
+                # fresh retry budgets
+                audited_ns.clear()
+                audit_fail_counts.clear()
                 # re-anchor the slots on the NEW decomposition right away,
                 # so a guard trip before the next cadence save rolls back
                 # onto the live grid instead of re-crossing the dims change
@@ -471,6 +518,37 @@ def run_resilient(step_local, state: dict, nt: int, *,
                     step_tuple, ndims, nt_chunk=n,
                     key=None if key is None else (key, "resilient"),
                     check_vma=check_vma, unroll=unroll)
+            t_built = time.monotonic()
+            if audit and n not in audited_ns \
+                    and audit_fail_counts.get(n, 0) < 2:
+                # per distinct program, at compile time: trace+lower only
+                # — the XLA executable the dispatch below builds is
+                # untouched; the audit's host cost is stamped on its own
+                # event, not folded into the chunk's build_s attribution
+                from ..analysis import audit_chunk_program
+                from ..telemetry.hooks import observe_audit
+
+                try:
+                    rep_audit = audit_chunk_program(
+                        runner, tuple(state[k] for k in names),
+                        names=names,
+                        reducer_floats=plan.length if plan is not None
+                        else 0,
+                        lints=audit_lints)
+                    observe_audit(rep_audit,
+                                  audit_s=time.monotonic() - t_built)
+                    audited_ns.add(n)
+                except Exception as e:
+                    # the audit OBSERVES — a parser tripped up by a new
+                    # dump format must degrade to a recorded failure,
+                    # never kill the supervised run it watches. One retry
+                    # at the next boundary separates a transient host
+                    # error from a permanently-broken parser (whose cost
+                    # must not be re-paid every chunk).
+                    audit_fail_counts[n] = audit_fail_counts.get(n, 0) + 1
+                    record_event("audit_failed", error=str(e),
+                                 audit_s=time.monotonic() - t_built,
+                                 attempt=audit_fail_counts[n])
             t_exec0 = time.monotonic()
             out = runner(*(state[k] for k in names))
             # tiny replicated fetch = the chunk drain; with reducers the
@@ -489,7 +567,7 @@ def run_resilient(step_local, state: dict, nt: int, *,
             record_event("chunk", chunk=rep.chunk, step_begin=step,
                          step_end=nb, n=n, ok=rep.ok,
                          reasons=list(rep.reasons),
-                         build_s=t_exec0 - t_build0,
+                         build_s=t_built - t_build0,
                          exec_s=t_done - t_exec0)
             if watch is not None:
                 # live drift detection: pure host arithmetic per boundary

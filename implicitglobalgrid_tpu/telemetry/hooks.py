@@ -18,7 +18,7 @@ from .registry import metrics_registry
 __all__ = ["note_runner_cache", "account_halo_exchange",
            "observe_checkpoint", "observe_snapshot", "note_io_queue",
            "observe_reducers", "note_heartbeat", "observe_perf",
-           "note_metrics_server_port"]
+           "note_metrics_server_port", "observe_audit"]
 
 # Metric family names (the exported contract; see docs/observability.md).
 RUNNER_CACHE = "igg_runner_cache_total"
@@ -39,6 +39,7 @@ PERF_RATIO = "igg_perf_model_ratio"
 PERF_Z = "igg_perf_zscore"
 PERF_REGRESSIONS = "igg_perf_regressions_total"
 METRICS_SERVER_PORT = "igg_metrics_server_port"
+AUDIT_FINDINGS = "igg_audit_findings_total"
 
 
 def runner_cache_misses() -> float:
@@ -199,6 +200,37 @@ def note_metrics_server_port(port: int) -> None:
         METRICS_SERVER_PORT,
         "TCP port the live /metrics+/healthz endpoint is bound to "
         "(0 = no server started yet this process).").set(int(port))
+
+
+def observe_audit(report, *, program: str = "chunk",
+                  audit_s: float | None = None) -> None:
+    """Record one static-analysis audit of a compiled program
+    (`analysis.AuditReport`, from `run_resilient(audit=True)` or any
+    caller of `analysis.audit_program`): every finding bumps the
+    ``igg_audit_findings_total{rule,severity}`` family and the full
+    report streams to the flight recorder as an ``audit`` event —
+    `run_report`'s ``"audit"`` section is reconstructed from that event
+    alone. ``audit_s`` (host seconds the audit itself took — trace +
+    lower + parse + check) rides on the event when the caller timed
+    it, keeping chunk ``build_s`` attribution honest."""
+    reg = metrics_registry()
+    fam = reg.counter(
+        AUDIT_FINDINGS,
+        "Static-analysis findings from compiled-program audits "
+        "(analysis.audit_program), by rule and severity.",
+        ("rule", "severity"))
+    for f in report.findings:
+        fam.inc(1, rule=f.rule, severity=f.severity)
+    rules = report.by_rule()
+    extra = {} if audit_s is None else {"audit_s": audit_s}
+    record_event("audit", program=program, dialect=report.dialect,
+                 ok=report.ok, errors=report.errors,
+                 warnings=report.warnings, rules=rules,
+                 findings=[f.to_json() for f in report.findings],
+                 collectives=report.collectives,
+                 crosscheck_ok=(None if report.crosscheck is None
+                                else bool(report.crosscheck.get("ok"))),
+                 **extra)
 
 
 def observe_reducers(step, values: dict, *, ok: bool = True) -> None:

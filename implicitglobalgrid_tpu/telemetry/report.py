@@ -42,6 +42,9 @@ _SEQ_FIELDS = {
     "snapshot_writer_close": ("submitted", "written", "staged", "dropped",
                               "errors", "bytes"),
     "reducers": ("step", "ok", "values"),
+    "audit": ("program", "dialect", "ok", "errors", "warnings", "rules",
+              "audit_s"),
+    "audit_failed": ("error", "audit_s", "attempt"),
     "perf_model": ("step_s", "bound", "source"),
     "perf_regression": ("chunk", "step_begin", "step_end", "per_step_s",
                         "baseline_s", "z", "ratio"),
@@ -77,6 +80,47 @@ def _perf_section(chunks: list, perf_model: dict | None,
         out["model_source"] = perf_model.get("source")
         if med and perf_model.get("step_s"):
             out["model_ratio_median"] = med / float(perf_model["step_s"])
+    return out
+
+
+def _audit_section(audits: list, failures: list = ()) -> dict:
+    """The report's ``"audit"`` block: the compile-time static-analysis
+    verdicts `run_resilient(audit=True)` streamed (one ``audit`` event per
+    audited program — one per run, plus one per elastic restart, whose
+    rebuilt program re-audits), reconstructed from the flight JSONL alone
+    like every other section. ``findings`` carries the full structured
+    records of the LAST audit (re-audits supersede earlier ones);
+    ``rules`` merges finding counts by rule across all of them;
+    ``failed`` counts audits that crashed (``audit_failed`` events — the
+    audit degrades, the run continues) with their error strings;
+    ``audit_s`` totals the audits' own host cost — successful AND failed
+    attempts (each event stamps its trace+lower+parse+check seconds,
+    kept out of chunk ``build_s``)."""
+    rules: dict = {}
+    for a in audits:
+        for rule, n in (a.get("rules") or {}).items():
+            rules[rule] = rules.get(rule, 0) + int(n)
+    last = audits[-1] if audits else None
+    out = {
+        "programs": len(audits),
+        "ok": (all(a.get("ok", False) for a in audits)
+               if audits else None),
+        "errors": sum(int(a.get("errors", 0)) for a in audits),
+        "warnings": sum(int(a.get("warnings", 0)) for a in audits),
+        "rules": dict(sorted(rules.items())),
+        "crosscheck_ok": None if last is None else last.get("crosscheck_ok"),
+        "findings": [] if last is None else list(last.get("findings") or ()),
+        "audit_s": (sum(float(a["audit_s"])
+                        for a in (*audits, *failures)
+                        if a.get("audit_s") is not None)
+                    if any(a.get("audit_s") is not None
+                           for a in (*audits, *failures))
+                    else None),
+    }
+    if failures:
+        out["failed"] = len(failures)
+        out["failed_errors"] = [f.get("error") for f in failures]
+        out["ok"] = False
     return out
 
 
@@ -164,6 +208,7 @@ def run_report(source, *, run_id: str | None = None,
     saves, restores, rollbacks = [], [], []
     trips, escalations, elastic = [], [], []
     perf_model, perf_regressions = None, []
+    audits, audit_failures = [], []
     begin = end = None
     halo = {"exchanges": 0, "ppermutes": 0, "wire_bytes": 0}
     io = {"snapshots_submitted": 0, "snapshots_written": 0,
@@ -213,6 +258,10 @@ def run_report(source, *, run_id: str | None = None,
             io["snapshot_errors"] += 1
         elif k == "reducers":
             io["reducer_points"] += 1
+        elif k == "audit":
+            audits.append(e)
+        elif k == "audit_failed":
+            audit_failures.append(e)
         elif k == "perf_model":
             perf_model = e
         elif k == "perf_regression":
@@ -264,6 +313,7 @@ def run_report(source, *, run_id: str | None = None,
             for e in elastic],
         "halo": halo,
         "io": io,
+        "audit": _audit_section(audits, audit_failures),
         "perf": _perf_section(chunks, perf_model, perf_regressions),
         "sequence": sequence,
     }

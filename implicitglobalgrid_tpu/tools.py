@@ -253,6 +253,15 @@ def _cli(argv=None) -> int:
       FLOP rate, per-mesh-axis link bandwidth/latency) on a
       self-initialized grid and print/persist the JSON the cost model
       (`telemetry.predict_step`) consumes.
+    - ``audit [model ...] [--hlo FILE] [--json]`` — static analysis of
+      compiled programs (`analysis.audit_model` / `audit_program`):
+      compile each model's step on a self-initialized grid (``--cpu`` for
+      the 8-device virtual mesh), check it against its plan-derived
+      collective contract + the implicit-grid lints, and cross-check the
+      perf oracle's collective pricing; or parse a captured HLO/StableHLO
+      dump host-only (``--hlo``, optionally against a ``--contract``
+      JSON). EXITS 1 when any error-severity finding survives — the CI
+      hook that makes the wire contract gate itself.
     """
     import argparse
     import json
@@ -356,7 +365,47 @@ def _cli(argv=None) -> int:
                           "default backend — a single-device backend has "
                           "no inter-shard link, so axes come out empty")
     cal.add_argument("--indent", type=int, default=2)
+    aud = sub.add_parser(
+        "audit", help="static analysis of compiled programs: collective "
+                      "contract + implicit-grid lints + perfmodel "
+                      "cross-check (exit 1 on error findings)")
+    aud.add_argument("models", nargs="*",
+                     help="model step programs to compile and audit "
+                          "(diffusion3d, diffusion2d, acoustic3d, "
+                          "stokes3d); omit with --hlo")
+    aud.add_argument("--hlo", default=None,
+                     help="audit a captured HLO/StableHLO text dump "
+                          "host-only instead of compiling a model")
+    aud.add_argument("--contract", default=None,
+                     help="CollectiveContract JSON to check --hlo against "
+                          "(default: lints only)")
+    aud.add_argument("--impl", default="xla",
+                     help="model step implementation (default xla — the "
+                          "path the static plan prices; any other impl "
+                          "audits lints only, contract+crosscheck "
+                          "skipped)")
+    aud.add_argument("--wire-dtype", default=None,
+                     help="reduced-precision wire dtype the exchange was "
+                          "built with (audits the downcast reached the "
+                          "wire)")
+    aud.add_argument("--lowered", action="store_true",
+                     help="audit the pre-backend StableHLO instead of "
+                          "backend-optimized HLO (where wire downcasts "
+                          "stay visible on CPU)")
+    aud.add_argument("--no-crosscheck", action="store_true",
+                     help="skip the predict_step pricing cross-check")
+    aud.add_argument("--json", action="store_true",
+                     help="machine-readable report instead of the summary")
+    aud.add_argument("--cpu", action="store_true",
+                     help="audit on the 8-device virtual CPU mesh (the "
+                          "bench scripts' convention)")
+    aud.add_argument("--nx", type=int, default=16,
+                     help="local block edge of the self-initialized grid")
+    aud.add_argument("--indent", type=int, default=2)
     args = ap.parse_args(argv)
+
+    if args.cmd == "audit":
+        return _cli_audit(args)
 
     from .telemetry import prometheus_snapshot, run_report
 
@@ -484,6 +533,105 @@ def _cli(argv=None) -> int:
                      include_metrics=not args.no_metrics)
     print(json.dumps(rep, indent=args.indent, default=str))
     return 0
+
+
+def _cli_audit(args) -> int:
+    """The ``audit`` subcommand: compile-and-audit model step programs, or
+    host-only parse a captured dump. Exit 1 when any error-severity
+    finding survives (the warning tier never gates)."""
+    import json
+    import os
+
+    from .utils.exceptions import InvalidArgumentError
+
+    if args.hlo is None and not args.models:
+        raise InvalidArgumentError(
+            "tools audit: name at least one model (diffusion3d, "
+            "diffusion2d, acoustic3d, stokes3d) or pass --hlo FILE.")
+    if args.hlo is not None and args.models:
+        raise InvalidArgumentError(
+            "tools audit: --hlo and model names are mutually exclusive "
+            "(a dump is audited host-only, models are compiled here).")
+
+    reports = []  # (name, AuditReport)
+    if args.hlo is not None:
+        from .analysis import (
+            CollectiveContract, audit_program, default_lint_config,
+        )
+
+        contract = None
+        if args.contract is not None:
+            with open(args.contract, encoding="utf-8") as f:
+                contract = CollectiveContract.from_json(f.read())
+        with open(args.hlo, encoding="utf-8") as f:
+            text = f.read()
+        # --wire-dtype applies to a captured dump too: its absence from
+        # the parsed permute payloads is the wire-downcast-missing lint
+        # (the compile-path knobs --impl/--lowered/--no-crosscheck have
+        # no meaning for a pre-captured text and are ignored here)
+        cfg = default_lint_config(wire_dtype=args.wire_dtype) \
+            if args.wire_dtype else None
+        reports.append((args.hlo, audit_program(
+            text, contract=contract, lint_config=cfg,
+            meta={"source": args.hlo})))
+    else:
+        # --wire-dtype is handled by audit_model itself: it scopes
+        # IGG_HALO_WIRE_DTYPE to the compile (and restores it) so the
+        # program and the derived contract agree on what should cross
+        # the link without leaking the mode into this process
+        if args.cpu:
+            # must precede any jax device use (the bench scripts' idiom)
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        import jax
+
+        from .analysis import audit_model
+        from .parallel.grid import finalize_global_grid, init_global_grid
+        from .parallel.topology import dims_create, grid_is_initialized
+
+        owns_grid = not grid_is_initialized()
+        if owns_grid:
+            dims = [int(d) for d in dims_create(len(jax.devices()),
+                                                (0, 0, 0))]
+            init_global_grid(args.nx, args.nx, args.nx, dimx=dims[0],
+                             dimy=dims[1], dimz=dims[2], periodx=1,
+                             periody=1, periodz=1, quiet=True)
+        try:
+            for model in args.models:
+                reports.append((model, audit_model(
+                    model, impl=args.impl, wire_dtype=args.wire_dtype,
+                    crosscheck=not args.no_crosscheck,
+                    optimized=not args.lowered)))
+        finally:
+            if owns_grid:
+                finalize_global_grid()
+
+    ok = all(rep.ok for _, rep in reports)
+    if args.json:
+        print(json.dumps(
+            {"ok": ok,
+             "programs": [dict(rep.to_json(), name=name)
+                          for name, rep in reports]},
+            indent=args.indent, default=str))
+    else:
+        for name, rep in reports:
+            cc = rep.crosscheck
+            cc_txt = "" if cc is None else \
+                f"  crosscheck={'ok' if cc['ok'] else 'DRIFT'}"
+            print(f"{name}: {'OK' if rep.ok else 'FAIL'} "
+                  f"[{rep.dialect}] errors={rep.errors} "
+                  f"warnings={rep.warnings} "
+                  f"collectives={rep.collectives['permutes']}p/"
+                  f"{rep.collectives['all_reduces']}ar/"
+                  f"{rep.collectives['all_gathers']}ag{cc_txt}")
+            for f in rep.findings:
+                anchor = f" @{f.computation}:{f.op}" if f.op else ""
+                print(f"  [{f.severity}] {f.rule}{anchor}: {f.message}")
+    return 0 if ok else 1
 
 
 def coords_g(dx, dy, dz, A):

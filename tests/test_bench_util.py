@@ -9,6 +9,8 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
 sys.path.insert(0, "/root/repo")
 
 import bench_util
@@ -79,6 +81,7 @@ def test_probe_backend_ok_and_failure():
     assert err is not None and "rc=" in err
 
 
+@pytest.mark.slow
 def test_run_with_retries_cpu_fallback(tmp_path):
     """End-to-end: backend probe fails → supervised rerun with --cpu →
     emitted row is tagged with the fallback note."""

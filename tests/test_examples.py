@@ -8,6 +8,8 @@ import re
 import subprocess
 import sys
 
+import pytest
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
@@ -44,6 +46,7 @@ def test_acoustic_example(tmp_path):
     assert "P interior" in out
 
 
+@pytest.mark.slow
 def test_advanced_modes_example(tmp_path):
     out = _run("diffusion3D_advanced_modes.py", tmp_path)
     # SR must beat plain bf16 against the f32 trajectory

@@ -9,7 +9,6 @@ controller its first addressable device's mesh position. Tested here with
 fake device objects (no multi-slice hardware needed).
 """
 
-import numpy as np
 import pytest
 
 from implicitglobalgrid_tpu.parallel.mesh import (

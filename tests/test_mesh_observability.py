@@ -11,7 +11,6 @@ lives in tests/test_multiprocess.py."""
 
 import json
 import os
-import threading
 import urllib.error
 import urllib.request
 

@@ -4,7 +4,6 @@ reference verifying distributed semantics against the implicit global grid
 (SURVEY.md §7 stage 4 acceptance)."""
 
 import numpy as np
-import pytest
 
 import implicitglobalgrid_tpu as igg
 from implicitglobalgrid_tpu.models import (

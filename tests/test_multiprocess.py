@@ -21,7 +21,6 @@ import subprocess
 import sys
 import textwrap
 
-import numpy as np
 import pytest
 
 _CHILD = textwrap.dedent("""

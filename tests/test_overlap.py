@@ -8,7 +8,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 import implicitglobalgrid_tpu as igg
-from implicitglobalgrid_tpu.models import DiffusionParams, init_diffusion3d
+from implicitglobalgrid_tpu.models import init_diffusion3d
 from implicitglobalgrid_tpu.ops.overlap import hide_communication
 from implicitglobalgrid_tpu.utils.compat import shard_map
 from implicitglobalgrid_tpu.ops.stencil import (

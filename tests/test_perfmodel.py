@@ -9,7 +9,6 @@ detects an injected 30% regression against a synthetic history while
 passing on noise."""
 
 import json
-import os
 import time
 
 import numpy as np

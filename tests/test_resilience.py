@@ -192,6 +192,15 @@ def test_state_validation():
     with pytest.raises(InvalidArgumentError, match="step range"):
         igg.run_resilient(step, state, 10,
                           faults=[igg.NaNPoke(step=99, name="T")])
+    # a typo'd lint rule must fail FAST at call time — inside the chunk
+    # loop it would only surface as a buried `audit_failed` event,
+    # silently disabling the audit the caller explicitly opted into
+    with pytest.raises(InvalidArgumentError, match="needs audit=True"):
+        igg.run_resilient(step, state, 10,
+                          audit_lints=("host-transfer",))
+    with pytest.raises(InvalidArgumentError, match="unknown lint rule"):
+        igg.run_resilient(step, state, 10, audit=True,
+                          audit_lints=("host-transfr",))
 
 
 # ---------------------------------------------------------------------------
@@ -230,22 +239,33 @@ def test_process_loss_elastic_restart_identical(tmp_path):
     """Simulated process loss at step 13: state abandoned, grid re-inited
     with dims=(1,2,2), last-good checkpoint redistributed elastically,
     lost steps recomputed — final interior identical to the reference run
-    on the ORIGINAL decomposition."""
+    on the ORIGINAL decomposition. With ``audit=True`` every DISTINCT
+    chunk program dispatched is audited once: the steady n=5 runner, the
+    fault-split n=3 runner, and — after the restart — the rebuilt
+    decomposition's n=5 runner again (three ``audit`` events, all
+    clean)."""
     P_ref = _reference_run(tmp_path)
 
     _init()
     igg.reset_health_counters()
     step, state = _diffusion_step()
-    out, reports = igg.run_resilient(
-        step, state, 20, nt_chunk=5, key="resil_loss",
-        checkpoint_dir=str(tmp_path / "ck"),
-        faults=[igg.ProcessLoss(step=13, new_dims=(1, 2, 2))])
+    igg.start_flight_recorder(str(tmp_path / "fr.jsonl"))
+    try:
+        out, reports = igg.run_resilient(
+            step, state, 20, nt_chunk=5, key="resil_loss",
+            checkpoint_dir=str(tmp_path / "ck"), audit=True,
+            faults=[igg.ProcessLoss(step=13, new_dims=(1, 2, 2))])
+    finally:
+        igg.stop_flight_recorder()
 
     gg = igg.global_grid()
     assert tuple(int(d) for d in gg.dims) == (1, 2, 2)  # run ended elastic
     c = igg.health_counters()
     assert c["elastic_restarts"] == 1
     assert np.array_equal(igg.gather_interior(out["T"]), P_ref)
+    audits = [e for e in igg.read_flight_events(str(tmp_path / "fr.jsonl"))
+              if e.get("kind") == "audit"]
+    assert len(audits) == 3 and all(a["ok"] for a in audits)
 
 
 @pytest.mark.faults

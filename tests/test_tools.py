@@ -144,6 +144,90 @@ def test_layout_override_disambiguates_small_blocks():
         local_shape_of((8, 4, 4), "global")
 
 
+@pytest.mark.audit
+def test_audit_cli_json_schema_and_model_smoke(capsys):
+    """`tools audit` smoke on both main model families in one invocation:
+    rc 0, and the --json schema carries the contract verdict, the
+    findings list, the collective summary, and the perfmodel crosscheck
+    per program."""
+    import json
+
+    from implicitglobalgrid_tpu.tools import _cli
+
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2, periodx=1,
+                         periody=1, periodz=1, quiet=True)
+    rc = _cli(["audit", "diffusion3d", "acoustic3d", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["ok"] is True
+    assert [p["name"] for p in out["programs"]] \
+        == ["diffusion3d", "acoustic3d"]
+    for prog in out["programs"]:
+        assert prog["ok"] is True and prog["dialect"] == "hlo"
+        assert prog["errors"] == 0 and prog["findings"] == []
+        assert prog["collectives"]["all_gathers"] == 0
+        assert prog["collectives"]["permutes"] > 0
+        assert prog["crosscheck"]["ok"] is True
+        assert set(prog["crosscheck"]["axes"]) == {"gx", "gy", "gz"}
+        assert isinstance(prog["inventory"], dict)
+    # the human-readable form of the same audit also exits 0
+    assert _cli(["audit", "diffusion3d"]) == 0
+    assert "diffusion3d: OK" in capsys.readouterr().out
+
+
+@pytest.mark.audit
+def test_audit_cli_exit_1_on_contract_violation(tmp_path, capsys):
+    """An injected contract violation (the golden single-axis exchange
+    checked against a contract demanding a guard psum it doesn't have)
+    EXITS 1 and names the broken rule — host-only, no grid, no compile."""
+    import json
+    import os
+    import shutil
+
+    from implicitglobalgrid_tpu.tools import _cli
+
+    fixture = os.path.join(os.path.dirname(__file__), "data", "hlo",
+                           "exchange_single_axis.hlo.txt")
+    hlo = tmp_path / "prog.hlo.txt"
+    shutil.copy(fixture, hlo)
+    contract = tmp_path / "contract.json"
+    contract.write_text(json.dumps(
+        {"allreduces": 1, "allreduce_payload": ["f32", 4]}))
+    rc = _cli(["audit", "--hlo", str(hlo), "--contract", str(contract),
+               "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["ok"] is False
+    rules = [f["rule"] for f in out["programs"][0]["findings"]]
+    assert "allreduce-count" in rules
+    assert all(f["severity"] in ("error", "warning", "info")
+               for f in out["programs"][0]["findings"])
+    # without the contract the same dump lints clean -> rc 0
+    assert _cli(["audit", "--hlo", str(hlo), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["ok"] is True
+    # --wire-dtype applies to captured dumps too: this dump's payloads
+    # are f32, so a claimed bf16 wire is a caught downcast-missing error
+    rc = _cli(["audit", "--hlo", str(hlo), "--wire-dtype", "bfloat16",
+               "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["rule"] for f in out["programs"][0]["findings"]] \
+        == ["wire-downcast-missing"]
+
+
+@pytest.mark.audit
+def test_audit_cli_argument_validation():
+    from implicitglobalgrid_tpu.tools import _cli
+    from implicitglobalgrid_tpu.utils.exceptions import (
+        InvalidArgumentError,
+    )
+
+    with pytest.raises(InvalidArgumentError):
+        _cli(["audit"])  # neither models nor --hlo
+    with pytest.raises(InvalidArgumentError):
+        _cli(["audit", "diffusion3d", "--hlo", "x.txt"])  # both
+
+
 def test_layout_override_coordinate_helpers():
     """x_g must honor layout= for the same ambiguous block the nx_g test
     documents: a (8,4,4) LOCAL block on a dims=(2,1,1) grid reads as stacked
