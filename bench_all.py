@@ -185,6 +185,16 @@ def main() -> None:
     for row in bench_perf.run_model_ratio(dims3, cpu):
         results.append(bench_util.emit(row))
 
+    # --- multi-run scheduler: steady-state multiplexing overhead -----------
+    # warm per-slice time of a two-job round_robin scheduler (every slice
+    # a context switch) vs a bare warm ResilientRun loop; target < 2%,
+    # warm switch cost recorded (ISSUE 8). Config owned by
+    # `bench_service.run_service_overhead` (shared with the standalone).
+    import bench_service
+
+    for row in bench_service.run_service_overhead(dims3, cpu):
+        results.append(bench_util.emit(row))
+
     # --- static analysis: compile-time audit overhead ----------------------
     # run_resilient(audit=True)'s one-time trace+lower+parse+check cost as
     # a fraction of run time; target < 2% (ISSUE 7). Config owned by

@@ -61,7 +61,8 @@ from .utils.checkpoint import (
     restore_checkpoint_elastic, saved_topology, elastic_local_size,
 )
 from .runtime import (
-    run_resilient, GuardConfig, HealthReport, RecoveryPolicy,
+    run_resilient, ResilientRun, RunSpec, GuardConfig, HealthReport,
+    RecoveryPolicy,
     NaNPoke, CheckpointCorruption, ProcessLoss,
     poke_nan, corrupt_checkpoint, elastic_restart,
 )
@@ -81,6 +82,11 @@ from . import io
 from .io import (
     SnapshotWriter, write_snapshot, open_snapshot, list_snapshots,
     Probe, AxisSlice, Stats,
+)
+from . import service
+from .service import (
+    MeshScheduler, JobSpec, JobState, service_report,
+    export_service_trace,
 )
 from . import analysis
 from .analysis import (
@@ -106,9 +112,13 @@ __all__ = [
     "save_checkpoint_sharded", "restore_checkpoint_sharded",
     "restore_checkpoint_elastic", "saved_topology", "elastic_local_size",
     # resilient runtime (supervised long runs)
-    "run_resilient", "GuardConfig", "HealthReport", "RecoveryPolicy",
+    "run_resilient", "ResilientRun", "RunSpec",
+    "GuardConfig", "HealthReport", "RecoveryPolicy",
     "NaNPoke", "CheckpointCorruption", "ProcessLoss",
     "poke_nan", "corrupt_checkpoint", "elastic_restart",
+    # multi-run scheduler (the mesh as a persistent simulation service)
+    "service", "MeshScheduler", "JobSpec", "JobState", "service_report",
+    "export_service_trace",
     "health_counters", "record_health_event", "reset_health_counters",
     # telemetry (metrics registry, flight recorder, exporters, run report)
     "MetricsRegistry", "metrics_registry", "reset_metrics",

@@ -234,6 +234,14 @@ class SnapshotWriter:
         self._closed = False
         self._stats = {"submitted": 0, "written": 0, "staged": 0,
                        "dropped": 0, "errors": 0, "bytes": 0}
+        # the writer thread's events belong to THE RUN THAT OWNS THIS
+        # WRITER: capture its recorder now and pin the thread to it —
+        # commits land asynchronously, when the process-wide current
+        # recorder may already be another tenant's (multi-run scheduler)
+        # or none at all (between slices)
+        from ..telemetry.recorder import flight_recorder
+
+        self._recorder = flight_recorder()
         self._thread = threading.Thread(
             target=self._run, name="igg-snapshot-writer", daemon=True)
         self._thread.start()
@@ -318,8 +326,10 @@ class SnapshotWriter:
 
     def _run(self) -> None:
         from ..telemetry.hooks import note_io_queue, observe_snapshot
-        from ..telemetry.recorder import record_event
+        from ..telemetry.recorder import bind_thread_recorder, record_event
 
+        if self._recorder is not None:
+            bind_thread_recorder(self._recorder)
         while True:
             with self._cv:
                 while not self._queue and not self._closed:

@@ -185,8 +185,17 @@ def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
             # execute/compile split the flight recorder attributes chunks to
             note_runner_cache("hit")
             return fn
-        if _runner_cache and next(iter(_runner_cache))[0] != gg.epoch:
-            _runner_cache.clear()
+        if _runner_cache:
+            # evict DEAD epochs only: after a plain re-init that is
+            # everything but the current epoch (the historical behavior),
+            # but the multi-run scheduler keeps several grids live at once
+            # (`topology.retain_epoch`) and their warm runners must survive
+            # its context switches
+            from ..parallel.topology import live_epochs
+
+            live = live_epochs()
+            for k in [k for k in _runner_cache if k[0] not in live]:
+                del _runner_cache[k]
     specs = tuple(field_partition_spec(nd) for nd in state_ndims)
     out_specs = specs
 

@@ -91,9 +91,16 @@ _plan_cache: dict = {}
 
 def free_update_halo_caches() -> None:
     """Drop compiled exchange programs (analog of
-    `free_update_halo_buffers`, reference `update_halo.jl:103-108`)."""
-    _exchange_cache.clear()
-    _plan_cache.clear()
+    `free_update_halo_buffers`, reference `update_halo.jl:103-108`).
+    Epochs RETAINED by the multi-run scheduler survive (one tenant's
+    finalize — e.g. inside an elastic restart — must not cold-start the
+    other tenants' exchanges); with nothing retained this is the full
+    clear it always was."""
+    from ..parallel.topology import _retained_epochs
+
+    for cache in (_exchange_cache, _plan_cache):
+        for k in [k for k in cache if k[0] not in _retained_epochs]:
+            del cache[k]
 
 
 def halo_may_use_pallas(gg=None) -> bool:

@@ -248,7 +248,11 @@ def finalize_global_grid(*, finalize_dist: bool = False) -> None:
     from ..utils import timing
 
     free_update_halo_caches()
-    timing._probe_cache.clear()
+    # barrier probes: same retention rule as the exchange caches — a
+    # scheduler-held tenant's probe survives another tenant's finalize
+    for k in [k for k in timing._probe_cache
+              if k not in top._retained_epochs]:
+        del timing._probe_cache[k]
     timing._t0 = None  # a chronometer from a dead grid epoch is meaningless
     if finalize_dist:
         import jax

@@ -37,6 +37,7 @@ __all__ = [
     "NDIMS", "NNEIGHBORS_PER_DIM", "PROC_NULL", "AXIS_NAMES",
     "GlobalGrid", "global_grid", "set_global_grid", "grid_is_initialized",
     "check_initialized", "get_global_grid", "grid_epoch",
+    "swap_global_grid", "retain_epoch", "release_epoch", "live_epochs",
     "dims_create", "cart_rank", "cart_coords", "cart_shift", "neighbors_table",
     "ol",
 ]
@@ -118,6 +119,57 @@ def get_global_grid() -> GlobalGrid:
 def grid_epoch() -> int:
     check_initialized()
     return _global_grid.epoch
+
+
+# ---------------------------------------------------------------------------
+# Grid multiplexing (the multi-run scheduler's context-switch primitives)
+# ---------------------------------------------------------------------------
+# A normal init assigns a FRESH epoch (set_global_grid bumps the counter),
+# which is what invalidates every epoch-keyed jit cache after a re-init.
+# The scheduler (`service.MeshScheduler`) instead keeps SEVERAL live grids
+# over one device pool and switches between them per slice; each keeps the
+# epoch it was born with, so each job's compiled runners/exchanges stay
+# warm across context switches. The caches learn which epochs are live via
+# `retain_epoch`/`live_epochs` and evict only the dead ones.
+
+_retained_epochs: set = set()
+
+
+def swap_global_grid(gg: GlobalGrid | None) -> GlobalGrid | None:
+    """Make ``gg`` the current grid WITHOUT assigning a new epoch, and
+    return the previously-current grid (or None). This is the scheduler's
+    context switch: the swapped-in grid keeps its original epoch, so the
+    epoch-keyed compiled-program caches (chunk runners, halo exchanges,
+    drain probes) keep serving it. Ordinary code wants `init_global_grid`
+    / `finalize_global_grid`; only hold multiple grids over the SAME
+    device pool."""
+    global _global_grid
+    old = _global_grid
+    _global_grid = gg
+    return old
+
+
+def retain_epoch(epoch: int) -> None:
+    """Mark ``epoch`` as belonging to a live (scheduler-held) grid: the
+    epoch-keyed caches will not evict its entries while retained."""
+    _retained_epochs.add(int(epoch))
+
+
+def release_epoch(epoch: int) -> None:
+    """Drop the retention of ``epoch`` (no-op if not retained). The epoch's
+    cache entries become evictable at the next cache miss; callers that
+    want the memory back NOW sweep the caches themselves (the scheduler
+    does, on job completion)."""
+    _retained_epochs.discard(int(epoch))
+
+
+def live_epochs() -> frozenset:
+    """Epochs whose compiled-program cache entries must survive: the
+    current grid's (if any) plus every retained one."""
+    live = set(_retained_epochs)
+    if _global_grid is not None:
+        live.add(_global_grid.epoch)
+    return frozenset(live)
 
 
 # ---------------------------------------------------------------------------

@@ -1,18 +1,22 @@
 """Resilient simulation runtime: supervised long runs over the chunked
 runners — on-device health guards, double-buffered elastic
 checkpoint-restart, deterministic fault injection (no reference analog;
-the reference's runtime story ends at `tic`/`toc`, SURVEY §5.4)."""
+the reference's runtime story ends at `tic`/`toc`, SURVEY §5.4). Since
+ISSUE 8 the driver loop is a resumable machine (`ResilientRun`, one
+`advance()` per chunk boundary) with its knob set factored into
+`RunSpec` — what the multi-run scheduler (`service/`) multiplexes."""
 
-from .driver import run_resilient
+from .driver import ResilientRun, run_resilient
 from .faults import (
     CheckpointCorruption, NaNPoke, ProcessLoss, corrupt_checkpoint,
     poke_nan,
 )
 from .health import GuardConfig, HealthReport, make_guarded_runner
 from .recovery import RecoveryPolicy, elastic_restart
+from .spec import RunSpec
 
 __all__ = [
-    "run_resilient",
+    "run_resilient", "ResilientRun", "RunSpec",
     "GuardConfig", "HealthReport", "make_guarded_runner",
     "RecoveryPolicy", "elastic_restart",
     "NaNPoke", "CheckpointCorruption", "ProcessLoss",

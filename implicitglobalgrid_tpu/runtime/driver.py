@@ -39,6 +39,16 @@ latencies, escalations, elastic restarts — as JSONL events that
 the compiled chunk program is bit-identical with telemetry on or off
 (`tests/test_hlo_audit.py`) and the measured overhead sits under the 2%
 gate (`bench_telemetry.py`).
+
+Since the multi-run scheduler (ISSUE 8) the loop itself is a RESUMABLE
+state machine: `ResilientRun` holds one supervised run's whole context
+(runner cache key, checkpoint slots, snapshot writer, perf watch, audit
+budgets) and `advance()` executes exactly ONE chunk-boundary iteration —
+faults due now, one supervised chunk, commit or recovery. `run_resilient`
+is the drain-it-to-completion loop over that machine; the
+`service.MeshScheduler` interleaves `advance()` calls of MANY machines
+through one device mesh (preemption is only ever at chunk boundaries, so a
+job's trajectory is bit-identical however it is sliced).
 """
 
 from __future__ import annotations
@@ -47,7 +57,9 @@ import json
 import os
 import time
 
-__all__ = ["run_resilient"]
+from .spec import RunSpec
+
+__all__ = ["run_resilient", "ResilientRun", "RunSpec"]
 
 
 class _CheckpointSlots:
@@ -137,21 +149,531 @@ class _CheckpointSlots:
             + ("\n  ".join(errors) if errors else "(no slot written yet)"))
 
 
+class ResilientRun:
+    """One supervised run as a resumable, chunk-granular state machine.
+
+    ``ResilientRun(step_local, state, nt, spec)`` performs the whole setup
+    `run_resilient` used to do inline (validation, metrics endpoint,
+    snapshot writer, checkpoint slots, perf watch) — a raising constructor
+    leaks none of those resources. Each `advance()` call then executes ONE
+    chunk-boundary iteration: heartbeat, faults due at this boundary, one
+    supervised chunk, commit-or-recover; it returns True while steps
+    remain. `close()` releases the run's resources (idempotent; call it on
+    every exit path — `run_resilient` does so in a ``finally``).
+
+    The machine is what makes the mesh a multiplexable resource: the
+    `service.MeshScheduler` holds many of these and interleaves their
+    `advance()` calls, so preemption happens only at chunk boundaries and
+    every job's trajectory is bit-identical to its solo run regardless of
+    the interleaving (asserted in tests/test_service.py)."""
+
+    def __init__(self, step_local, state: dict, nt: int,
+                 spec: RunSpec | None = None):
+        import numpy as np
+
+        from ..parallel.topology import check_initialized
+        from ..telemetry import record_event
+        from ..telemetry.hooks import note_heartbeat
+        from ..utils.exceptions import InvalidArgumentError
+        from .faults import NaNPoke, ProcessLoss
+        from .health import GuardConfig
+        from .recovery import RecoveryPolicy
+
+        spec = spec if spec is not None else RunSpec()
+        check_initialized()
+        if not isinstance(state, dict) or not state:
+            raise InvalidArgumentError(
+                "run_resilient expects a non-empty dict of name -> stacked "
+                "array (names become checkpoint keys and HealthReport "
+                "entries).")
+        self.spec = spec
+        self.step_local = step_local
+        self.state = state
+        self.names = list(state)
+        self.guard = spec.guard if spec.guard is not None else GuardConfig()
+        self.policy = (spec.policy if spec.policy is not None
+                       else RecoveryPolicy())
+        self.nt = int(nt)
+        self.cur_chunk = max(1, int(spec.nt_chunk))
+        self.checkpoint_every = max(1, int(
+            spec.checkpoint_every if spec.checkpoint_every is not None
+            else self.cur_chunk))
+        self.pending = list(spec.faults)
+        for f in self.pending:
+            if isinstance(f, (NaNPoke, ProcessLoss)) \
+                    and not 0 <= f.step < self.nt:
+                raise InvalidArgumentError(
+                    f"Fault {f} is outside the run's step range "
+                    f"[0, {self.nt}).")
+            if isinstance(f, NaNPoke):
+                if f.name not in state:
+                    raise InvalidArgumentError(
+                        f"NaNPoke names unknown field {f.name!r}.")
+                shape = state[f.name].shape
+                # OOB scatter updates are silently DROPPED by jax — a
+                # mistyped index would inject nothing and the drill would
+                # pass vacuously
+                if len(f.index) != len(shape) or any(
+                        not 0 <= int(i) < s
+                        for i, s in zip(f.index, shape)):
+                    raise InvalidArgumentError(
+                        f"NaNPoke index {tuple(f.index)} is outside field "
+                        f"{f.name!r} of stacked shape {tuple(shape)}.")
+        if spec.audit_lints is not None and not spec.audit:
+            raise InvalidArgumentError(
+                "audit_lints selects rules for the compile-time audit — it "
+                "needs audit=True.")
+        if spec.audit_lints is not None:
+            # fail fast on a typo'd rule name: inside the chunk loop it
+            # would only surface as a buried `audit_failed` event (the
+            # audit degrades by design), silently disabling the requested
+            # audit
+            from ..analysis import LINT_RULES
+
+            unknown = sorted(set(spec.audit_lints) - set(LINT_RULES))
+            if unknown:
+                raise InvalidArgumentError(
+                    f"audit_lints: unknown lint rule(s) {unknown}; "
+                    f"available: {sorted(LINT_RULES)}.")
+        self._np = np
+        self._note_heartbeat = note_heartbeat
+        self._record_event = record_event
+        self.reducers = tuple(spec.reducers)
+        # --- performance oracle: model attachment + live drift detector --
+        model_step_s = model_bound = model_source = None
+        if spec.perf_model is not None:
+            if isinstance(spec.perf_model, dict):
+                model_step_s = spec.perf_model.get("step_s")
+                model_bound = spec.perf_model.get("bound")
+                model_source = spec.perf_model.get("profile_source")
+            else:
+                model_step_s = spec.perf_model
+            try:
+                model_step_s = float(model_step_s)
+            except (TypeError, ValueError):
+                model_step_s = None
+            if not model_step_s or model_step_s <= 0:
+                raise InvalidArgumentError(
+                    "perf_model must be a telemetry.predict_step record "
+                    "(with a positive 'step_s') or modeled per-step "
+                    f"seconds; got {spec.perf_model!r}.")
+        self._model_step_s = model_step_s
+        self._model_bound = model_bound
+        self._model_source = model_source
+        self.watch = None
+        if int(spec.perf_window) > 0:
+            from ..telemetry.perfmodel import PerfWatch
+
+            self.watch = PerfWatch(window=int(spec.perf_window),
+                                   zmax=float(spec.perf_zmax),
+                                   model_step_s=model_step_s)
+        # the live endpoint comes up FIRST: a port conflict must fail the
+        # call before any other resource (writer thread, checkpoint dirs)
+        # spins up
+        self.server = None
+        if spec.metrics_port is not None:
+            from ..telemetry.server import start_metrics_server
+
+            self.server = start_metrics_server(
+                int(spec.metrics_port),
+                healthz_max_age_s=spec.healthz_max_age_s)
+        elif spec.healthz_max_age_s is not None:
+            raise InvalidArgumentError(
+                "healthz_max_age_s needs metrics_port (it configures the "
+                "/healthz endpoint the driver starts).")
+        self.writer = None
+        try:
+            self.slots = (_CheckpointSlots(spec.checkpoint_dir)
+                          if spec.checkpoint_dir is not None else None)
+            if spec.snapshot_dir is not None:
+                from ..io.snapshot import SnapshotWriter
+
+                # validate the field selection NOW, not at the first
+                # cadence boundary — a typo'd name must fail before step 1,
+                # not 50000 steps in
+                if spec.snapshot_fields is not None:
+                    unknown = [f for f in spec.snapshot_fields
+                               if f not in state]
+                    if unknown:
+                        raise InvalidArgumentError(
+                            f"snapshot_fields {unknown} are not in the "
+                            f"state (have {self.names}).")
+                self.writer = SnapshotWriter(
+                    spec.snapshot_dir, queue_depth=spec.snapshot_queue,
+                    policy=spec.snapshot_policy,
+                    fields=spec.snapshot_fields)
+            elif spec.snapshot_every is not None \
+                    or spec.snapshot_fields is not None \
+                    or spec.snapshot_policy != "block" \
+                    or spec.snapshot_queue != 2:
+                raise InvalidArgumentError(
+                    "snapshot_every/snapshot_fields/snapshot_queue/"
+                    "snapshot_policy need snapshot_dir to write into.")
+            self.snapshot_every = max(1, int(
+                spec.snapshot_every if spec.snapshot_every is not None
+                else self.cur_chunk))
+            record_event("run_begin", nt=self.nt, nt_chunk=self.cur_chunk,
+                         checkpoint_every=self.checkpoint_every,
+                         names=self.names,
+                         checkpointing=self.slots is not None,
+                         faults=len(self.pending),
+                         snapshots=self.writer is not None,
+                         snapshot_every=(self.snapshot_every
+                                         if self.writer else None),
+                         reducers=len(self.reducers))
+            if model_step_s is not None:
+                record_event("perf_model", step_s=model_step_s,
+                             bound=model_bound, source=model_source)
+        except BaseException:
+            # a failed setup must not leak the endpoint or the writer
+            # thread
+            if self.writer is not None:
+                self.writer.close()
+            if self.server is not None:
+                from ..telemetry.server import stop_metrics_server
+
+                stop_metrics_server()
+            raise
+
+        self.reports = []
+        self.step = 0
+        self.chunk_idx = 0
+        self.retries = 0
+        self.saves = 0
+        # each distinct chunk length n is a distinct jitted program (the
+        # runner cache keys on it): audit every one the run dispatches,
+        # once — a cadence-clipped first chunk must not leave the
+        # steady-state program unaudited. Failures get ONE retry at a
+        # later boundary (transient host error != permanently-broken
+        # parser).
+        self._audited_ns: set = set()
+        self._audit_fail_counts: dict = {}
+        self._started = False
+        self._finished = False
+        self._closed = False
+
+    # -- derived views -----------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        """True once the run completed all ``nt`` steps (the ``run_end``
+        event has been recorded)."""
+        return self._finished
+
+    def _step_tuple(self, tup):
+        out = self.step_local(dict(zip(self.names, tup)))
+        return tuple(out[k] for k in self.names)
+
+    # -- recovery helpers ---------------------------------------------------
+
+    def _save(self, st, at_step):
+        import jax
+
+        from ..utils import profiling
+        from .faults import CheckpointCorruption, corrupt_checkpoint
+
+        path = self.slots.save(st, at_step)
+        profiling.record_health_event("checkpoints_saved")
+        due = [f for f in self.pending
+               if isinstance(f, CheckpointCorruption)
+               and f.save_index == self.saves]
+        for f in due:
+            self.pending.remove(f)
+            self._record_event("fault_injected",
+                               fault="CheckpointCorruption",
+                               save_index=f.save_index, corruption=f.kind,
+                               target=f.target)
+            # one damage event, not one per process: applied by process 0
+            # only (a second bitflip would undo the first; a second delete
+            # would race-crash), made visible to all before anyone reads
+            if jax.process_index() == 0:
+                corrupt_checkpoint(path, kind=f.kind, target=f.target,
+                                   process=f.process)
+        if due and jax.process_count() > 1:
+            from ..utils.timing import barrier
+
+            barrier()
+        self.saves += 1
+
+    def _elastic_recover(self, new_dims):
+        from ..utils import profiling
+        from ..utils.exceptions import ResilienceError
+        from .recovery import elastic_restart
+
+        errors = []
+        for i, path in enumerate(self.slots.candidates()):
+            try:
+                st, at = elastic_restart(path, new_dims)
+            except Exception as e:
+                errors.append(f"{path}: {e}")
+                continue
+            profiling.record_health_event("restores")
+            if i > 0:
+                profiling.record_health_event("restore_fallbacks")
+            return st, int(at or 0)
+        raise ResilienceError(
+            "Elastic restart failed on every checkpoint slot:\n  "
+            + "\n  ".join(errors))
+
+    # -- the chunk-boundary iteration ---------------------------------------
+
+    def advance(self) -> bool:
+        """Execute ONE chunk-boundary iteration; return True while steps
+        remain (False once the run is complete). The first call performs
+        the initial step-0 checkpoint save; the call that commits step
+        ``nt`` records the ``run_end`` event. Preemption between calls is
+        safe — this is the scheduler's slice boundary."""
+        if self._finished:
+            return False
+        if not self._started:
+            self._started = True
+            if self.slots is not None:
+                # rollback ALWAYS possible, even before step 1
+                self._save(self.state, 0)
+        if self.step < self.nt:
+            self._iterate()
+        if self.step >= self.nt and not self._finished:
+            self._note_heartbeat(self.step)
+            self._record_event("run_end", completed=self.step,
+                               chunks=self.chunk_idx)
+            self._finished = True
+        return not self._finished
+
+    def _iterate(self):
+        np = self._np
+        record_event = self._record_event
+
+        from ..telemetry.hooks import runner_cache_misses
+        from ..utils import profiling
+        from ..utils.exceptions import ResilienceError
+        from .faults import NaNPoke, ProcessLoss, poke_nan
+        from .health import make_guarded_runner, report_from_stats
+
+        # liveness stamp at every boundary (normal commit, retry, and
+        # elastic-restart paths all come back through here): the /healthz
+        # age resets as long as the driver is making progress
+        self._note_heartbeat(self.step)
+        step = self.step
+        # --- faults due at this boundary (chunks split on them) ----------
+        for f in [f for f in self.pending
+                  if isinstance(f, NaNPoke) and f.step == step]:
+            self.pending.remove(f)
+            self.state = dict(self.state)
+            self.state[f.name] = poke_nan(self.state[f.name], f.index)
+            record_event("fault_injected", fault="NaNPoke", step=f.step,
+                         name=f.name)
+        loss = next((f for f in self.pending
+                     if isinstance(f, ProcessLoss) and f.step == step),
+                    None)
+        if loss is not None:
+            self.pending.remove(loss)
+            record_event("fault_injected", fault="ProcessLoss",
+                         step=loss.step, new_dims=list(loss.new_dims))
+            if self.slots is None:
+                raise ResilienceError(
+                    "ProcessLoss injected with no checkpoint_dir — "
+                    "nothing to restart from.")
+            self.state, self.step = self._elastic_recover(loss.new_dims)
+            profiling.record_health_event("elastic_restarts")
+            record_event("elastic_restart", new_dims=list(loss.new_dims),
+                         to_step=self.step)
+            # the restart rebuilds the chunk program for the NEW
+            # decomposition — audit that one too (run_report's audit
+            # section treats the last audit as authoritative), with fresh
+            # retry budgets
+            self._audited_ns.clear()
+            self._audit_fail_counts.clear()
+            # re-anchor the slots on the NEW decomposition right away, so
+            # a guard trip before the next cadence save rolls back onto
+            # the live grid instead of re-crossing the dims change
+            self._save(self.state, self.step)
+            return
+
+        # --- one supervised chunk ----------------------------------------
+        nb = min(step + self.cur_chunk, self.nt)
+        if self.slots is not None:  # align to the checkpoint cadence
+            nb = min(nb, (step // self.checkpoint_every + 1)
+                     * self.checkpoint_every)
+        if self.writer is not None:  # ... and to the snapshot cadence
+            nb = min(nb, (step // self.snapshot_every + 1)
+                     * self.snapshot_every)
+        for f in self.pending:
+            if isinstance(f, (NaNPoke, ProcessLoss)) and step < f.step < nb:
+                nb = f.step
+        n = nb - step
+        state, names, spec = self.state, self.names, self.spec
+
+        ndims = tuple(state[k].ndim for k in names)
+        sizes = [int(np.prod(state[k].shape)) for k in names]
+        misses0 = runner_cache_misses() if self.watch is not None else 0.0
+        t_build0 = time.monotonic()
+        if self.reducers:
+            from ..io.reducers import build_reducer_plan, \
+                make_reduced_post_chunk
+            from ..models.common import make_state_runner
+
+            # rebuilt per boundary (cheap host work): the ownership
+            # geometry follows the LIVE decomposition — an elastic restart
+            # changes it — and the plan signature joins the runner key, so
+            # stale compiled hooks can never serve
+            plan = build_reducer_plan(self.reducers, names, state)
+            runner = make_state_runner(
+                self._step_tuple, ndims, nt_chunk=n,
+                key=None if spec.key is None
+                else (spec.key, "resilient-io", plan.signature),
+                check_vma=spec.check_vma, unroll=spec.unroll,
+                post_chunk=make_reduced_post_chunk(names, plan))
+        else:
+            plan = None
+            runner = make_guarded_runner(
+                self._step_tuple, ndims, nt_chunk=n,
+                key=None if spec.key is None else (spec.key, "resilient"),
+                check_vma=spec.check_vma, unroll=spec.unroll)
+        t_built = time.monotonic()
+        if spec.audit and n not in self._audited_ns \
+                and self._audit_fail_counts.get(n, 0) < 2:
+            # per distinct program, at compile time: trace+lower only —
+            # the XLA executable the dispatch below builds is untouched;
+            # the audit's host cost is stamped on its own event, not
+            # folded into the chunk's build_s attribution
+            from ..analysis import audit_chunk_program
+            from ..telemetry.hooks import observe_audit
+
+            try:
+                rep_audit = audit_chunk_program(
+                    runner, tuple(state[k] for k in names), names=names,
+                    reducer_floats=plan.length if plan is not None else 0,
+                    lints=spec.audit_lints)
+                observe_audit(rep_audit,
+                              audit_s=time.monotonic() - t_built)
+                self._audited_ns.add(n)
+            except Exception as e:
+                # the audit OBSERVES — a parser tripped up by a new dump
+                # format must degrade to a recorded failure, never kill
+                # the supervised run it watches. One retry at the next
+                # boundary separates a transient host error from a
+                # permanently-broken parser (whose cost must not be
+                # re-paid every chunk).
+                self._audit_fail_counts[n] = \
+                    self._audit_fail_counts.get(n, 0) + 1
+                record_event("audit_failed", error=str(e),
+                             audit_s=time.monotonic() - t_built,
+                             attempt=self._audit_fail_counts[n])
+        t_exec0 = time.monotonic()
+        out = runner(*(state[k] for k in names))
+        # tiny replicated fetch = the chunk drain; with reducers the
+        # vector carries [health | reducer segments] from ONE psum
+        vec = np.asarray(out[-1])
+        t_done = time.monotonic()
+        rep = report_from_stats(vec[:2 * len(names)], names, sizes,
+                                self.guard, chunk=self.chunk_idx,
+                                step_begin=step, step_end=nb)
+        self.chunk_idx += 1
+        self.reports.append(rep)
+        profiling.record_health_event("chunks")
+        # exec_s covers dispatch through the stats fetch (= the chunk
+        # drain); a chunk right after a runner-cache miss also pays the
+        # XLA compile inside it — run_report flags those chunks as cold
+        record_event("chunk", chunk=rep.chunk, step_begin=step,
+                     step_end=nb, n=n, ok=rep.ok,
+                     reasons=list(rep.reasons),
+                     build_s=t_built - t_build0,
+                     exec_s=t_done - t_exec0)
+        if self.watch is not None:
+            # live drift detection: pure host arithmetic per boundary (a
+            # cold chunk — its dispatch paid the XLA compile after a
+            # runner-cache miss — updates gauges only)
+            verdict = self.watch.observe(
+                chunk=rep.chunk, step_begin=step, step_end=nb, n=n,
+                exec_s=t_done - t_exec0,
+                cold=runner_cache_misses() > misses0)
+            if verdict is not None:
+                record_event("perf_regression", **verdict)
+        if plan is not None:
+            from ..telemetry.hooks import observe_reducers
+
+            values = plan.decode(vec[2 * len(names):])
+            observe_reducers(nb, values, ok=rep.ok)
+            if spec.on_reduce is not None:
+                spec.on_reduce(nb, values)
+        if spec.on_report is not None:
+            spec.on_report(rep)
+
+        if rep.ok:
+            self.state = dict(zip(names, out[:-1]))
+            self.step = nb
+            self.retries = 0
+            # cadence saves, plus the TERMINAL state: without the latter a
+            # run whose nt is off-cadence could never be resumed from its
+            # own end
+            if self.slots is not None \
+                    and (self.step % self.checkpoint_every == 0
+                         or self.step >= self.nt):
+                self._save(self.state, self.step)
+            if self.writer is not None \
+                    and (self.step % self.snapshot_every == 0
+                         or self.step >= self.nt):
+                kept = self.writer.submit(self.state, self.step)
+                record_event("snapshot", step=self.step,
+                             displaced=not kept)
+            return
+
+        # --- guard tripped: bounded-retry rollback ------------------------
+        profiling.record_health_event("guard_trips")
+        self.retries += 1
+        record_event("guard_trip", step_end=nb, reasons=list(rep.reasons),
+                     retries=self.retries)
+        if self.slots is None:
+            raise ResilienceError(
+                f"Health guard tripped at step {nb} "
+                f"({', '.join(rep.reasons)}) and no checkpoint_dir is "
+                "configured — cannot roll back.")
+        if self.retries > self.policy.max_retries:
+            raise ResilienceError(
+                f"Health guard tripped {self.retries} consecutive times "
+                f"at step {nb} ({', '.join(rep.reasons)}); retry budget "
+                f"({self.policy.max_retries}) exhausted.")
+        if self.policy.backoff_s:
+            time.sleep(self.policy.backoff_s * 2 ** (self.retries - 1))
+        if self.retries >= self.policy.shrink_chunk_after \
+                and self.cur_chunk > self.policy.min_nt_chunk:
+            self.cur_chunk = max(self.policy.min_nt_chunk,
+                                 self.cur_chunk // 2)
+            profiling.record_health_event("escalations")
+            record_event("escalation", retries=self.retries,
+                         nt_chunk=self.cur_chunk, step=step)
+            if self.policy.on_escalate is not None:
+                self.policy.on_escalate({"retries": self.retries,
+                                         "nt_chunk": self.cur_chunk,
+                                         "step": step})
+        self.state, self.step, fellback = self.slots.restore()
+        profiling.record_health_event("rollbacks")
+        profiling.record_health_event("restores")
+        if fellback:
+            profiling.record_health_event("restore_fallbacks")
+        record_event("rollback", to_step=self.step, fallback=fellback,
+                     retries=self.retries)
+
+    def close(self) -> None:
+        """Release the run's resources (metrics endpoint, snapshot-writer
+        drain) — idempotent, safe on every exit path."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.server is not None:
+            from ..telemetry.server import stop_metrics_server
+
+            stop_metrics_server()
+        if self.writer is not None:
+            # drain on EVERY exit path (normal end, retry-budget
+            # ResilienceError, a user exception out of on_report): every
+            # submitted snapshot is on disk before the caller proceeds
+            self.writer.close()
+            self._record_event("snapshot_writer_close", **self.writer.stats)
+
+
 def run_resilient(step_local, state: dict, nt: int, *,
-                  nt_chunk: int = 100, key=None,
-                  checkpoint_dir=None, checkpoint_every: int | None = None,
-                  guard=None, policy=None, faults=(),
-                  on_report=None, check_vma: bool | None = None,
-                  unroll: int | None = None,
-                  snapshot_dir=None, snapshot_every: int | None = None,
-                  snapshot_fields=None, snapshot_queue: int = 2,
-                  snapshot_policy: str = "block",
-                  reducers=(), on_reduce=None,
-                  metrics_port: int | None = None,
-                  healthz_max_age_s: float | None = None,
-                  perf_model=None, perf_window: int = 16,
-                  perf_zmax: float = 4.0,
-                  audit: bool = False, audit_lints=None):
+                  spec: RunSpec | None = None, **kwargs):
     """Advance ``state`` by ``nt`` steps under health supervision with
     checkpoint-rollback recovery. Returns ``(state, reports)``.
 
@@ -161,6 +683,12 @@ def run_resilient(step_local, state: dict, nt: int, *,
     global arrays — the names key the checkpoints and `HealthReport`
     entries. ``key`` (hashable) enables the runner cache across chunks
     (strongly recommended: without it every chunk recompiles).
+
+    The knobs travel either as keywords (exactly as before — the
+    historical surface) or pre-packed as ``spec=RunSpec(...)`` (what the
+    multi-run scheduler's `service.JobSpec` embeds); passing both raises.
+    This function is a thin shim over the resumable `ResilientRun`
+    machine: construct, drain `advance()` to completion, `close()`.
 
     ``checkpoint_dir`` enables recovery: double-buffered sharded slots +
     last-good pointer, saved every ``checkpoint_every`` steps (default:
@@ -193,12 +721,15 @@ def run_resilient(step_local, state: dict, nt: int, *,
     (`telemetry.start_metrics_server`) for the duration of the run —
     ``/metrics`` serves the Prometheus snapshot, ``/healthz`` the age of
     the driver heartbeat; ``0`` binds an ephemeral port (read it from
-    ``igg.metrics_server().port``). ``healthz_max_age_s`` makes
-    ``/healthz`` return 503 when the heartbeat is older — the wedged-
-    driver restart signal a supervisor's HTTP probe acts on; size it to
-    a few chunk durations. Binds 127.0.0.1 — see the security note in
-    docs/observability.md. The heartbeat gauges themselves are stamped
-    at every chunk boundary whether or not a server runs.
+    ``igg.metrics_server().port``). When a server is already live in the
+    process (e.g. the scheduler's long-lived endpoint), the run ATTACHES
+    to it instead of failing to bind (`telemetry.server` refcounts
+    starts). ``healthz_max_age_s`` makes ``/healthz`` return 503 when the
+    heartbeat is older — the wedged-driver restart signal a supervisor's
+    HTTP probe acts on; size it to a few chunk durations. Binds
+    127.0.0.1 — see the security note in docs/observability.md. The
+    heartbeat gauges themselves are stamped at every chunk boundary
+    whether or not a server runs.
 
     Performance oracle (`telemetry.perfmodel`, host-side only): every
     chunk boundary feeds the live drift detector — a rolling per-step
@@ -231,426 +762,19 @@ def run_resilient(step_local, state: dict, nt: int, *,
     ``igg_audit_findings_total{rule,severity}`` metric family; an
     error-severity finding does NOT abort the run (the audit observes,
     operators gate via the report/CLI)."""
-    import numpy as np
-
-    from ..parallel.topology import check_initialized
-    from ..telemetry import record_event
-    from ..utils import profiling
-    from ..utils.exceptions import InvalidArgumentError, ResilienceError
+    from ..utils.exceptions import InvalidArgumentError
     from ..utils.timing import sync
-    from .faults import CheckpointCorruption, NaNPoke, ProcessLoss, \
-        corrupt_checkpoint, poke_nan
-    from .health import GuardConfig, make_guarded_runner, report_from_stats
-    from .recovery import RecoveryPolicy
 
-    check_initialized()
-    if not isinstance(state, dict) or not state:
+    if spec is not None and kwargs:
         raise InvalidArgumentError(
-            "run_resilient expects a non-empty dict of name -> stacked "
-            "array (names become checkpoint keys and HealthReport "
-            "entries).")
-    names = list(state)
-    guard = guard if guard is not None else GuardConfig()
-    policy = policy if policy is not None else RecoveryPolicy()
-    nt = int(nt)
-    cur_chunk = max(1, int(nt_chunk))
-    checkpoint_every = max(1, int(checkpoint_every
-                                  if checkpoint_every is not None
-                                  else cur_chunk))
-    pending = list(faults)
-    for f in pending:
-        if isinstance(f, (NaNPoke, ProcessLoss)) and not 0 <= f.step < nt:
-            raise InvalidArgumentError(
-                f"Fault {f} is outside the run's step range [0, {nt}).")
-        if isinstance(f, NaNPoke):
-            if f.name not in state:
-                raise InvalidArgumentError(
-                    f"NaNPoke names unknown field {f.name!r}.")
-            shape = state[f.name].shape
-            # OOB scatter updates are silently DROPPED by jax — a mistyped
-            # index would inject nothing and the drill would pass vacuously
-            if len(f.index) != len(shape) or any(
-                    not 0 <= int(i) < s for i, s in zip(f.index, shape)):
-                raise InvalidArgumentError(
-                    f"NaNPoke index {tuple(f.index)} is outside field "
-                    f"{f.name!r} of stacked shape {tuple(shape)}.")
-    if audit_lints is not None and not audit:
-        raise InvalidArgumentError(
-            "audit_lints selects rules for the compile-time audit — it "
-            "needs audit=True.")
-    if audit_lints is not None:
-        # fail fast on a typo'd rule name: inside the chunk loop it would
-        # only surface as a buried `audit_failed` event (the audit
-        # degrades by design), silently disabling the requested audit
-        from ..analysis import LINT_RULES
-
-        unknown = sorted(set(audit_lints) - set(LINT_RULES))
-        if unknown:
-            raise InvalidArgumentError(
-                f"audit_lints: unknown lint rule(s) {unknown}; "
-                f"available: {sorted(LINT_RULES)}.")
-    # the live endpoint comes up FIRST: a port conflict must fail the call
-    # before any other resource (writer thread, checkpoint dirs) spins up
-    from ..telemetry.hooks import note_heartbeat, runner_cache_misses
-
-    reducers = tuple(reducers)
-    # --- performance oracle: model attachment + live drift detector ------
-    model_step_s = model_bound = model_source = None
-    if perf_model is not None:
-        if isinstance(perf_model, dict):
-            model_step_s = perf_model.get("step_s")
-            model_bound = perf_model.get("bound")
-            model_source = perf_model.get("profile_source")
-        else:
-            model_step_s = perf_model
-        try:
-            model_step_s = float(model_step_s)
-        except (TypeError, ValueError):
-            model_step_s = None
-        if not model_step_s or model_step_s <= 0:
-            raise InvalidArgumentError(
-                "perf_model must be a telemetry.predict_step record (with "
-                "a positive 'step_s') or modeled per-step seconds; got "
-                f"{perf_model!r}.")
-    watch = None
-    if int(perf_window) > 0:
-        from ..telemetry.perfmodel import PerfWatch
-
-        watch = PerfWatch(window=int(perf_window), zmax=float(perf_zmax),
-                          model_step_s=model_step_s)
-    server = None
-    if metrics_port is not None:
-        from ..telemetry.server import start_metrics_server
-
-        server = start_metrics_server(
-            int(metrics_port), healthz_max_age_s=healthz_max_age_s)
-    elif healthz_max_age_s is not None:
-        raise InvalidArgumentError(
-            "healthz_max_age_s needs metrics_port (it configures the "
-            "/healthz endpoint the driver starts).")
-    writer = None
+            "run_resilient: pass the knobs either pre-packed via spec= or "
+            f"as keywords, not both (got spec plus {sorted(kwargs)}).")
+    if spec is None:
+        spec = RunSpec(**kwargs)
+    run = ResilientRun(step_local, state, nt, spec)
     try:
-        slots = (_CheckpointSlots(checkpoint_dir)
-                 if checkpoint_dir is not None else None)
-        if snapshot_dir is not None:
-            from ..io.snapshot import SnapshotWriter
-
-            # validate the field selection NOW, not at the first cadence
-            # boundary — a typo'd name must fail before step 1, not 50000
-            # steps in
-            if snapshot_fields is not None:
-                unknown = [f for f in snapshot_fields if f not in state]
-                if unknown:
-                    raise InvalidArgumentError(
-                        f"snapshot_fields {unknown} are not in the state "
-                        f"(have {names}).")
-            writer = SnapshotWriter(snapshot_dir,
-                                    queue_depth=snapshot_queue,
-                                    policy=snapshot_policy,
-                                    fields=snapshot_fields)
-        elif snapshot_every is not None or snapshot_fields is not None \
-                or snapshot_policy != "block" or snapshot_queue != 2:
-            raise InvalidArgumentError(
-                "snapshot_every/snapshot_fields/snapshot_queue/"
-                "snapshot_policy need snapshot_dir to write into.")
-        snapshot_every = max(1, int(snapshot_every
-                                    if snapshot_every is not None
-                                    else cur_chunk))
-        record_event("run_begin", nt=nt, nt_chunk=cur_chunk,
-                     checkpoint_every=checkpoint_every, names=names,
-                     checkpointing=slots is not None, faults=len(pending),
-                     snapshots=writer is not None,
-                     snapshot_every=snapshot_every if writer else None,
-                     reducers=len(reducers))
-        if model_step_s is not None:
-            record_event("perf_model", step_s=model_step_s,
-                         bound=model_bound, source=model_source)
-    except BaseException:
-        # a failed setup must not leak the endpoint or the writer thread
-        if writer is not None:
-            writer.close()
-        if server is not None:
-            from ..telemetry.server import stop_metrics_server
-
-            stop_metrics_server()
-        raise
-
-    def step_tuple(tup):
-        out = step_local(dict(zip(names, tup)))
-        return tuple(out[k] for k in names)
-
-    reports = []
-    step = 0
-    chunk_idx = 0
-    retries = 0
-    saves = 0
-    # each distinct chunk length n is a distinct jitted program (the
-    # runner cache keys on it): audit every one the run dispatches, once
-    # — a cadence-clipped first chunk must not leave the steady-state
-    # program unaudited. Failures get ONE retry at a later boundary
-    # (transient host error != permanently-broken parser).
-    audited_ns: set = set()
-    audit_fail_counts: dict = {}
-
-    def _save(st, at_step):
-        nonlocal saves
-        import jax
-
-        path = slots.save(st, at_step)
-        profiling.record_health_event("checkpoints_saved")
-        due = [f for f in pending
-               if isinstance(f, CheckpointCorruption)
-               and f.save_index == saves]
-        for f in due:
-            pending.remove(f)
-            record_event("fault_injected", fault="CheckpointCorruption",
-                         save_index=f.save_index, corruption=f.kind,
-                         target=f.target)
-            # one damage event, not one per process: applied by process 0
-            # only (a second bitflip would undo the first; a second delete
-            # would race-crash), made visible to all before anyone reads
-            if jax.process_index() == 0:
-                corrupt_checkpoint(path, kind=f.kind, target=f.target,
-                                   process=f.process)
-        if due and jax.process_count() > 1:
-            from ..utils.timing import barrier
-
-            barrier()
-        saves += 1
-
-    def _elastic_recover(new_dims):
-        from .recovery import elastic_restart
-
-        errors = []
-        for i, path in enumerate(slots.candidates()):
-            try:
-                st, at = elastic_restart(path, new_dims)
-            except Exception as e:
-                errors.append(f"{path}: {e}")
-                continue
-            profiling.record_health_event("restores")
-            if i > 0:
-                profiling.record_health_event("restore_fallbacks")
-            return st, int(at or 0)
-        raise ResilienceError(
-            "Elastic restart failed on every checkpoint slot:\n  "
-            + "\n  ".join(errors))
-
-    try:
-        if slots is not None:
-            _save(state, 0)  # rollback ALWAYS possible, even before step 1
-        while step < nt:
-            # liveness stamp at every boundary (normal commit, retry, and
-            # elastic-restart paths all come back through here): the
-            # /healthz age resets as long as the driver is making progress
-            note_heartbeat(step)
-            # --- faults due at this boundary (chunks split on them) ------
-            for f in [f for f in pending
-                      if isinstance(f, NaNPoke) and f.step == step]:
-                pending.remove(f)
-                state = dict(state)
-                state[f.name] = poke_nan(state[f.name], f.index)
-                record_event("fault_injected", fault="NaNPoke", step=f.step,
-                             name=f.name)
-            loss = next((f for f in pending
-                         if isinstance(f, ProcessLoss) and f.step == step),
-                        None)
-            if loss is not None:
-                pending.remove(loss)
-                record_event("fault_injected", fault="ProcessLoss",
-                             step=loss.step, new_dims=list(loss.new_dims))
-                if slots is None:
-                    raise ResilienceError(
-                        "ProcessLoss injected with no checkpoint_dir — "
-                        "nothing to restart from.")
-                state, step = _elastic_recover(loss.new_dims)
-                profiling.record_health_event("elastic_restarts")
-                record_event("elastic_restart",
-                             new_dims=list(loss.new_dims), to_step=step)
-                # the restart rebuilds the chunk program for the NEW
-                # decomposition — audit that one too (run_report's audit
-                # section treats the last audit as authoritative), with
-                # fresh retry budgets
-                audited_ns.clear()
-                audit_fail_counts.clear()
-                # re-anchor the slots on the NEW decomposition right away,
-                # so a guard trip before the next cadence save rolls back
-                # onto the live grid instead of re-crossing the dims change
-                _save(state, step)
-                continue
-
-            # --- one supervised chunk ------------------------------------
-            nb = min(step + cur_chunk, nt)
-            if slots is not None:  # align to the checkpoint cadence
-                nb = min(nb,
-                         (step // checkpoint_every + 1) * checkpoint_every)
-            if writer is not None:  # ... and to the snapshot cadence
-                nb = min(nb, (step // snapshot_every + 1) * snapshot_every)
-            for f in pending:
-                if isinstance(f, (NaNPoke, ProcessLoss)) \
-                        and step < f.step < nb:
-                    nb = f.step
-            n = nb - step
-
-            ndims = tuple(state[k].ndim for k in names)
-            sizes = [int(np.prod(state[k].shape)) for k in names]
-            misses0 = runner_cache_misses() if watch is not None else 0.0
-            t_build0 = time.monotonic()
-            if reducers:
-                from ..io.reducers import build_reducer_plan, \
-                    make_reduced_post_chunk
-                from ..models.common import make_state_runner
-
-                # rebuilt per boundary (cheap host work): the ownership
-                # geometry follows the LIVE decomposition — an elastic
-                # restart changes it — and the plan signature joins the
-                # runner key, so stale compiled hooks can never serve
-                plan = build_reducer_plan(reducers, names, state)
-                runner = make_state_runner(
-                    step_tuple, ndims, nt_chunk=n,
-                    key=None if key is None
-                    else (key, "resilient-io", plan.signature),
-                    check_vma=check_vma, unroll=unroll,
-                    post_chunk=make_reduced_post_chunk(names, plan))
-            else:
-                plan = None
-                runner = make_guarded_runner(
-                    step_tuple, ndims, nt_chunk=n,
-                    key=None if key is None else (key, "resilient"),
-                    check_vma=check_vma, unroll=unroll)
-            t_built = time.monotonic()
-            if audit and n not in audited_ns \
-                    and audit_fail_counts.get(n, 0) < 2:
-                # per distinct program, at compile time: trace+lower only
-                # — the XLA executable the dispatch below builds is
-                # untouched; the audit's host cost is stamped on its own
-                # event, not folded into the chunk's build_s attribution
-                from ..analysis import audit_chunk_program
-                from ..telemetry.hooks import observe_audit
-
-                try:
-                    rep_audit = audit_chunk_program(
-                        runner, tuple(state[k] for k in names),
-                        names=names,
-                        reducer_floats=plan.length if plan is not None
-                        else 0,
-                        lints=audit_lints)
-                    observe_audit(rep_audit,
-                                  audit_s=time.monotonic() - t_built)
-                    audited_ns.add(n)
-                except Exception as e:
-                    # the audit OBSERVES — a parser tripped up by a new
-                    # dump format must degrade to a recorded failure,
-                    # never kill the supervised run it watches. One retry
-                    # at the next boundary separates a transient host
-                    # error from a permanently-broken parser (whose cost
-                    # must not be re-paid every chunk).
-                    audit_fail_counts[n] = audit_fail_counts.get(n, 0) + 1
-                    record_event("audit_failed", error=str(e),
-                                 audit_s=time.monotonic() - t_built,
-                                 attempt=audit_fail_counts[n])
-            t_exec0 = time.monotonic()
-            out = runner(*(state[k] for k in names))
-            # tiny replicated fetch = the chunk drain; with reducers the
-            # vector carries [health | reducer segments] from ONE psum
-            vec = np.asarray(out[-1])
-            t_done = time.monotonic()
-            rep = report_from_stats(vec[:2 * len(names)], names, sizes,
-                                    guard, chunk=chunk_idx,
-                                    step_begin=step, step_end=nb)
-            chunk_idx += 1
-            reports.append(rep)
-            profiling.record_health_event("chunks")
-            # exec_s covers dispatch through the stats fetch (= the chunk
-            # drain); a chunk right after a runner-cache miss also pays the
-            # XLA compile inside it — run_report flags those chunks as cold
-            record_event("chunk", chunk=rep.chunk, step_begin=step,
-                         step_end=nb, n=n, ok=rep.ok,
-                         reasons=list(rep.reasons),
-                         build_s=t_built - t_build0,
-                         exec_s=t_done - t_exec0)
-            if watch is not None:
-                # live drift detection: pure host arithmetic per boundary
-                # (a cold chunk — its dispatch paid the XLA compile after
-                # a runner-cache miss — updates gauges only)
-                verdict = watch.observe(
-                    chunk=rep.chunk, step_begin=step, step_end=nb, n=n,
-                    exec_s=t_done - t_exec0,
-                    cold=runner_cache_misses() > misses0)
-                if verdict is not None:
-                    record_event("perf_regression", **verdict)
-            if plan is not None:
-                from ..telemetry.hooks import observe_reducers
-
-                values = plan.decode(vec[2 * len(names):])
-                observe_reducers(nb, values, ok=rep.ok)
-                if on_reduce is not None:
-                    on_reduce(nb, values)
-            if on_report is not None:
-                on_report(rep)
-
-            if rep.ok:
-                state = dict(zip(names, out[:-1]))
-                step = nb
-                retries = 0
-                # cadence saves, plus the TERMINAL state: without the
-                # latter a run whose nt is off-cadence could never be
-                # resumed from its own end
-                if slots is not None and (step % checkpoint_every == 0
-                                          or step >= nt):
-                    _save(state, step)
-                if writer is not None and (step % snapshot_every == 0
-                                           or step >= nt):
-                    kept = writer.submit(state, step)
-                    record_event("snapshot", step=step, displaced=not kept)
-                continue
-
-            # --- guard tripped: bounded-retry rollback -------------------
-            profiling.record_health_event("guard_trips")
-            retries += 1
-            record_event("guard_trip", step_end=nb,
-                         reasons=list(rep.reasons), retries=retries)
-            if slots is None:
-                raise ResilienceError(
-                    f"Health guard tripped at step {nb} "
-                    f"({', '.join(rep.reasons)}) and no checkpoint_dir is "
-                    "configured — cannot roll back.")
-            if retries > policy.max_retries:
-                raise ResilienceError(
-                    f"Health guard tripped {retries} consecutive times at "
-                    f"step {nb} ({', '.join(rep.reasons)}); retry budget "
-                    f"({policy.max_retries}) exhausted.")
-            if policy.backoff_s:
-                time.sleep(policy.backoff_s * 2 ** (retries - 1))
-            if retries >= policy.shrink_chunk_after \
-                    and cur_chunk > policy.min_nt_chunk:
-                cur_chunk = max(policy.min_nt_chunk, cur_chunk // 2)
-                profiling.record_health_event("escalations")
-                record_event("escalation", retries=retries,
-                             nt_chunk=cur_chunk, step=step)
-                if policy.on_escalate is not None:
-                    policy.on_escalate({"retries": retries,
-                                        "nt_chunk": cur_chunk,
-                                        "step": step})
-            state, step, fellback = slots.restore()
-            profiling.record_health_event("rollbacks")
-            profiling.record_health_event("restores")
-            if fellback:
-                profiling.record_health_event("restore_fallbacks")
-            record_event("rollback", to_step=step, fallback=fellback,
-                         retries=retries)
-
-        note_heartbeat(step)
-        record_event("run_end", completed=step, chunks=chunk_idx)
+        while run.advance():
+            pass
     finally:
-        if server is not None:
-            from ..telemetry.server import stop_metrics_server
-
-            stop_metrics_server()
-        if writer is not None:
-            # drain on EVERY exit path (normal end, retry-budget
-            # ResilienceError, a user exception out of on_report): every
-            # submitted snapshot is on disk before the caller proceeds
-            writer.close()
-            record_event("snapshot_writer_close", **writer.stats)
-    return sync(state), reports
+        run.close()
+    return sync(run.state), run.reports

@@ -1,0 +1,30 @@
+"""Multi-run scheduler: the mesh as a persistent simulation service
+(ISSUE 8 tentpole; no reference analog — the reference is one script,
+one run, one exit).
+
+`MeshScheduler` owns the device mesh and multiplexes QUEUED jobs through
+it in chunk-granular time slices over the existing runner cache: every
+job gets its own grid (different models/grid sizes share one device
+pool), its own `runtime.ResilientRun` (checkpoints, snapshots, reducers,
+perf watch, audit — the PR 2-7 surface, per tenant), and its own flight
+JSONL; the scheduler owns the long-lived /metrics + /healthz endpoint
+with per-job labeled gauges. `service_report`/`export_service_trace`
+reconstruct the interleaved schedule post-hoc (one Perfetto track per
+job); `tools jobs submit|list|status|cancel|drain` is the operator CLI.
+"""
+
+from .job import BUILTIN_MODELS, Job, JobSpec, JobState, builtin_setup
+from .policies import (
+    FairSharePolicy, FifoPolicy, POLICIES, RoundRobinPolicy,
+    SchedulingPolicy, resolve_policy,
+)
+from .report import export_service_trace, is_service_dir, service_report
+from .scheduler import MeshScheduler
+
+__all__ = [
+    "MeshScheduler",
+    "JobSpec", "Job", "JobState", "builtin_setup", "BUILTIN_MODELS",
+    "SchedulingPolicy", "FifoPolicy", "RoundRobinPolicy",
+    "FairSharePolicy", "POLICIES", "resolve_policy",
+    "service_report", "export_service_trace", "is_service_dir",
+]

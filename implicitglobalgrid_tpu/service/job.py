@@ -1,0 +1,222 @@
+"""Jobs: what the persistent-mesh scheduler admits and multiplexes.
+
+A job is a complete supervised run waiting to happen: a grid geometry
+(its own `init_global_grid` arguments — jobs with DIFFERENT models and
+grid sizes share one device pool), a setup callable that builds the step
+function and state UNDER that grid, a step budget, the full
+`runtime.RunSpec` knob set (checkpoints, snapshots, reducers, perf
+watch, audit — every subsystem of PRs 2-7 becomes per-tenant), and
+scheduling metadata (priority weight, optional deadline).
+
+`JobSpec` is the immutable submission; `Job` is the scheduler's live
+record of it (state machine QUEUED → RUNNING → DONE/FAILED/CANCELLED,
+slice accounting, the underlying `ResilientRun`). `builtin_setup` maps
+the model names the CLI accepts (``diffusion3d`` …) to setup callables so
+a job queue can be described in plain JSON (`tools jobs submit`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..runtime.spec import RunSpec
+from ..utils.exceptions import InvalidArgumentError
+
+__all__ = ["JobSpec", "Job", "JobState", "builtin_setup", "BUILTIN_MODELS"]
+
+
+class JobState:
+    """Job lifecycle states (plain strings — they travel through JSON
+    journals and Prometheus labels)."""
+
+    QUEUED = "queued"        # submitted, not yet granted a slice
+    RUNNING = "running"      # admitted: grid + state live, being sliced
+    DONE = "done"            # completed all nt steps; result available
+    FAILED = "failed"        # raised (retry budget, fatal guard, setup)
+    CANCELLED = "cancelled"  # cancelled before completion
+
+    TERMINAL = (DONE, FAILED, CANCELLED)
+
+
+@dataclass(frozen=True)
+class JobSpec:
+    """One queued simulation.
+
+    ``name`` must be unique within a scheduler (it keys the flight JSONL,
+    the journal, and every per-job metric label). ``setup`` is called
+    ONCE, at admission, with the job's grid current — it returns
+    ``(step_local, state)`` exactly as `run_resilient` takes them.
+    ``grid`` holds `init_global_grid` keyword arguments (``quiet=True``
+    is applied unless overridden); the scheduler builds a SEPARATE grid
+    per job over the same device pool and context-switches between them.
+    ``run`` is the embedded `runtime.RunSpec` (all ~20 supervised-run
+    knobs — not re-declared here). ``priority`` is the weight the
+    ``fair`` policy shares mesh time by (higher = more slices; must be
+    >= 1); ``deadline_s`` is advisory metadata (journaled, reported, and
+    exported so an operator can alert on it — no policy enforces it
+    yet)."""
+
+    name: str
+    setup: Callable[[], tuple]
+    nt: int
+    grid: dict = field(default_factory=dict)
+    run: RunSpec = field(default_factory=RunSpec)
+    priority: int = 1
+    deadline_s: float | None = None
+
+    def __post_init__(self):
+        if not self.name or "/" in str(self.name):
+            raise InvalidArgumentError(
+                f"JobSpec.name must be a non-empty, slash-free string "
+                f"(it names files); got {self.name!r}.")
+        if not callable(self.setup):
+            raise InvalidArgumentError(
+                "JobSpec.setup must be callable () -> (step_local, state).")
+        if int(self.nt) <= 0:
+            raise InvalidArgumentError(
+                f"JobSpec.nt must be positive; got {self.nt}.")
+        if not isinstance(self.run, RunSpec):
+            raise InvalidArgumentError(
+                "JobSpec.run must be a runtime.RunSpec (it embeds the "
+                "supervised-run knob set instead of re-declaring it).")
+        if int(self.priority) < 1:
+            raise InvalidArgumentError(
+                f"JobSpec.priority is a fair-share weight >= 1; got "
+                f"{self.priority}.")
+
+
+class Job:
+    """The scheduler's live record of one submitted `JobSpec`."""
+
+    def __init__(self, spec: JobSpec, index: int):
+        self.spec = spec
+        self.index = index              # submission order (fifo key)
+        self.state = JobState.QUEUED
+        self.gg = None                  # this job's GlobalGrid, once admitted
+        self.run = None                 # the ResilientRun machine
+        self.recorder = None            # per-job FlightRecorder (or None)
+        self.scope = None               # per-job ScopedRegistry gauges
+        self.error: str | None = None
+        self.result = None              # final state dict (DONE only)
+        self.reports = None
+        self.submitted_t: float | None = None
+        self.started_t: float | None = None
+        self.finished_t: float | None = None
+        self.admit_s: float = 0.0       # grid init + user setup cost
+        self.slices = 0
+        self.slice_s_total = 0.0
+        self.wait_s_total = 0.0
+        self.cancel_requested = False
+        self.last_end_t: float | None = None
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    @property
+    def step(self) -> int:
+        return 0 if self.run is None else int(self.run.step)
+
+    @property
+    def finished(self) -> bool:
+        return self.state in JobState.TERMINAL
+
+    def status(self) -> dict:
+        """JSON-able snapshot (the `tools jobs status` record)."""
+        trips = 0 if self.reports is None and self.run is None else sum(
+            1 for r in (self.reports if self.reports is not None
+                        else self.run.reports) if not r.ok)
+        return {
+            "name": self.name, "state": self.state, "nt": int(self.spec.nt),
+            "step": self.step, "priority": int(self.spec.priority),
+            "deadline_s": self.spec.deadline_s,
+            "slices": self.slices,
+            "slice_s_total": self.slice_s_total,
+            "wait_s_total": self.wait_s_total,
+            "admit_s": self.admit_s,
+            "guard_trips": trips,
+            "submitted_t": self.submitted_t, "started_t": self.started_t,
+            "finished_t": self.finished_t, "error": self.error,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Built-in model setups (the CLI's JSON-describable jobs)
+# ---------------------------------------------------------------------------
+
+def _setup_diffusion3d(dtype):
+    from ..models import diffusion_step_local, init_diffusion3d
+
+    T, Cp, p = init_diffusion3d(dtype=dtype)
+
+    def step(s):
+        return {"T": diffusion_step_local(s["T"], s["Cp"], p, "xla"),
+                "Cp": s["Cp"]}
+
+    return step, {"T": T, "Cp": Cp}
+
+
+def _setup_diffusion2d(dtype):
+    from ..models import diffusion_step_local, init_diffusion2d
+
+    T, Cp, p = init_diffusion2d(dtype=dtype)
+
+    def step(s):
+        return {"T": diffusion_step_local(s["T"], s["Cp"], p, "xla"),
+                "Cp": s["Cp"]}
+
+    return step, {"T": T, "Cp": Cp}
+
+
+def _setup_acoustic3d(dtype):
+    from ..models import acoustic_step_local, init_acoustic3d
+
+    state, p = init_acoustic3d(dtype=dtype)
+    names = ("P", "Vx", "Vy", "Vz")
+
+    def step(s):
+        out = acoustic_step_local(tuple(s[n] for n in names), p, "xla")
+        return dict(zip(names, out))
+
+    return step, dict(zip(names, state))
+
+
+def _setup_stokes3d(dtype):
+    from ..models import init_stokes3d, stokes_step_local
+
+    state, p = init_stokes3d(dtype=dtype)
+    names = ("P", "Vx", "Vy", "Vz", "dVx", "dVy", "dVz", "rhog")
+
+    def step(s):
+        out = stokes_step_local(tuple(s[n] for n in names), p, "xla")
+        return dict(zip(names, out))
+
+    return step, dict(zip(names, state))
+
+
+BUILTIN_MODELS = {
+    "diffusion3d": _setup_diffusion3d,
+    "diffusion2d": _setup_diffusion2d,
+    "acoustic3d": _setup_acoustic3d,
+    "stokes3d": _setup_stokes3d,
+}
+
+
+def builtin_setup(model: str, dtype: str = "float32"):
+    """A `JobSpec.setup` callable for a built-in model family — what
+    `tools jobs submit` builds from a JSON job description. The callable
+    runs at ADMISSION, under the job's own grid."""
+    if model not in BUILTIN_MODELS:
+        raise InvalidArgumentError(
+            f"Unknown model {model!r}; available: "
+            f"{sorted(BUILTIN_MODELS)}.")
+    import numpy as np
+
+    dt = np.dtype(dtype).type
+
+    def setup():
+        return BUILTIN_MODELS[model](dt)
+
+    setup.__qualname__ = f"builtin_setup({model!r}, {dtype!r})"
+    return setup

@@ -1,0 +1,487 @@
+"""`MeshScheduler` — the device mesh as a persistent, multiplexed resource.
+
+`run_resilient` owns the mesh for exactly one job; the scheduler inverts
+that: IT owns the mesh (and the ops surface — the long-lived /metrics +
+/healthz endpoint, the flight journal) and advances QUEUED jobs through
+it in chunk-granular slices:
+
+    sched = igg.service.MeshScheduler(policy="fair", flight_dir="/logs/q",
+                                      metrics_port=9100)
+    sched.submit(igg.service.JobSpec(name="a", setup=..., nt=2000,
+                                     grid=dict(nx=64, ny=64, nz=64)))
+    sched.submit(...)                      # different model/grid size: fine
+    sched.run()                            # drain the queue
+    final_states = sched.results()
+
+Mechanics, in one paragraph: every job gets its OWN grid over the shared
+device pool (`init_global_grid` at admission — jobs may have different
+models, grid sizes, even decompositions) and its own `ResilientRun`
+machine (checkpoint slots, snapshot writer, perf watch, audit budgets,
+flight recorder — the whole PR 2-7 per-run surface becomes per-tenant).
+A context switch is two pointer swaps: `topology.swap_global_grid` makes
+the job's grid current WITHOUT a new epoch, and
+`use_flight_recorder` routes the driver's events into the job's JSONL.
+Because the compiled-program caches are epoch-keyed and scheduler-held
+epochs are RETAINED (`topology.retain_epoch`), each job's chunk runners,
+halo exchanges, and drain probes stay warm across switches — the cold
+XLA compile is paid once, inside the first slice of the job that needs
+it (visible as that job's ``cold`` chunk in its flight stream), and a
+warm switch costs ~1 ms of bookkeeping (measured in bench_service.py,
+gated < 2% of the chunk work a slice carries).
+
+Isolation: a guard trip, rollback, elastic restart, or injected fault in
+one job runs entirely inside that job's slice, against that job's
+checkpoints, on that job's grid — the other tenants' trajectories are
+bit-identical to their solo runs (the PR-2 fault-injection harness is
+the tenant-isolation test bed, tests/test_service.py). A job that
+exhausts its retry budget FAILS alone; the scheduler records the error
+and keeps serving the rest.
+
+Preemption is only ever at chunk boundaries (one `advance()` per granted
+slice), so the scheduling policy (`fifo` | `round_robin` | `fair`)
+affects latency and fairness, never results.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..parallel import topology as top
+from ..runtime.driver import ResilientRun
+from ..telemetry import hooks
+from ..telemetry.recorder import FlightRecorder, use_flight_recorder
+from ..utils.exceptions import InvalidArgumentError
+from .job import Job, JobSpec, JobState
+from .policies import resolve_policy
+
+__all__ = ["MeshScheduler"]
+
+
+def _evict_epoch_caches(epoch: int) -> None:
+    """Drop a finished job's compiled programs from every epoch-keyed
+    cache NOW (release_epoch alone only makes them evictable later)."""
+    from ..models import common
+    from ..ops import halo
+    from ..utils import timing
+
+    for cache in (common._runner_cache, halo._exchange_cache,
+                  halo._plan_cache, timing._drain_cache):
+        for k in [k for k in cache if k[0] == epoch]:
+            del cache[k]
+    timing._probe_cache.pop(epoch, None)
+
+
+class MeshScheduler:
+    """Single-process persistent-mesh scheduler (see module docstring).
+
+    ``policy``: ``"fifo"`` | ``"round_robin"`` | ``"fair"`` (or a
+    `SchedulingPolicy` instance). ``flight_dir``: per-job flight JSONLs
+    (``job_<name>.jsonl``) plus the scheduler's own journal
+    (``scheduler.jsonl``) land here — `igg.run_report(flight_dir)`
+    reconstructs the interleaved schedule and
+    `service.export_service_trace` renders one Perfetto track per job;
+    the directory doubles as the CLI's control channel (`tools jobs
+    cancel|drain` file requests, polled at slice boundaries).
+    ``metrics_port`` starts the scheduler-OWNED live endpoint for the
+    scheduler's lifetime: per-job labeled gauges, queue depth, and a
+    /healthz that judges the SCHEDULER heartbeat (a wedged single job
+    must not 503 the service; its staleness shows in
+    ``igg_job_heartbeat_timestamp_seconds{job=...}``). A
+    `run_resilient(metrics_port=...)` running under (or next to) the
+    scheduler ATTACHES to this server instead of failing to bind.
+
+    The scheduler is a context manager; `close()` releases every job's
+    resources and restores whatever grid was current at construction."""
+
+    def __init__(self, *, policy="fifo", flight_dir=None,
+                 metrics_port: int | None = None,
+                 healthz_max_age_s: float | None = None):
+        self.policy = resolve_policy(policy)
+        self.flight_dir = None if flight_dir is None else str(flight_dir)
+        self.jobs: dict = {}
+        self._order: list = []
+        self._n_submitted = 0
+        self.slices = 0
+        self._closed = False
+        # per-tenant audit attribution baseline: slices are serialized, so
+        # the global finding-counter's growth during a slice belongs to
+        # the job that ran it — ONE scheduler-level baseline (a per-job
+        # zero would hand each first slice every earlier tenant's total)
+        self._audit_seen = self._audit_total()
+        self._draining = False
+        self._journal = None
+        self._server = None
+        if self.flight_dir is not None:
+            os.makedirs(self.flight_dir, exist_ok=True)
+            self._journal = FlightRecorder(
+                os.path.join(self.flight_dir, "scheduler.jsonl"),
+                run_id="scheduler")
+        try:
+            if metrics_port is not None:
+                from ..telemetry.server import start_metrics_server
+
+                self._server = start_metrics_server(
+                    int(metrics_port),
+                    healthz_max_age_s=healthz_max_age_s)
+            elif healthz_max_age_s is not None:
+                raise InvalidArgumentError(
+                    "healthz_max_age_s needs metrics_port (it configures "
+                    "the /healthz endpoint the scheduler starts).")
+        except BaseException:
+            if self._journal is not None:
+                self._journal.close()
+            raise
+        hooks.note_scheduler_heartbeat()
+        self._log("scheduler_start", policy=self.policy.name,
+                  wall=time.time(),
+                  metrics_port=None if self._server is None
+                  else self._server.port)
+
+    @staticmethod
+    def _audit_total() -> float:
+        fam = hooks.metrics_registry().get(hooks.AUDIT_FINDINGS)
+        return sum(v for _, v in fam.samples()) if fam is not None else 0.0
+
+    # -- journal -----------------------------------------------------------
+
+    def _log(self, kind: str, **fields) -> None:
+        if self._journal is not None:
+            self._journal.event(kind, **fields)
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, spec: JobSpec) -> Job:
+        """Queue one job. Admission (grid + state construction) is LAZY —
+        it happens inside the job's first granted slice, so its cost is
+        attributed to the job that pays it, not to the submitter."""
+        self._check_open()
+        if not isinstance(spec, JobSpec):
+            raise InvalidArgumentError(
+                f"submit takes a JobSpec; got {type(spec).__name__}.")
+        if spec.name in self.jobs:
+            raise InvalidArgumentError(
+                f"A job named {spec.name!r} was already submitted "
+                "(names key flight files and metric labels).")
+        if self._draining:
+            raise InvalidArgumentError(
+                "The scheduler is draining — no new admissions.")
+        job = Job(spec, self._n_submitted)
+        self._n_submitted += 1
+        job.submitted_t = time.time()
+        job.last_end_t = time.monotonic()
+        self.jobs[spec.name] = job
+        self._order.append(job)
+        hooks.note_job_transition("submitted")
+        self._update_queue_gauges()
+        # NB "run" is the flight recorder's reserved run-id key — the
+        # spec payload must travel under its own name
+        self._log("job_submitted", job=spec.name, nt=int(spec.nt),
+                  priority=int(spec.priority),
+                  deadline_s=spec.deadline_s, grid=dict(spec.grid),
+                  run_spec=spec.run.to_json())
+        return job
+
+    # -- queries -----------------------------------------------------------
+
+    def job(self, name: str) -> Job:
+        if name not in self.jobs:
+            raise InvalidArgumentError(
+                f"No job named {name!r} (have "
+                f"{[j.name for j in self._order]}).")
+        return self.jobs[name]
+
+    def runnable(self) -> list:
+        """Jobs that can take a slice right now, in submission order."""
+        return [j for j in self._order if not j.finished]
+
+    def results(self) -> dict:
+        """``name -> final state dict`` of every DONE job."""
+        return {j.name: j.result for j in self._order
+                if j.state == JobState.DONE}
+
+    def status(self) -> dict:
+        """JSON-able service snapshot (queue depths + per-job records)."""
+        states: dict = {}
+        for j in self._order:
+            states[j.state] = states.get(j.state, 0) + 1
+        return {"policy": self.policy.name, "slices": self.slices,
+                "jobs": [j.status() for j in self._order],
+                "states": states,
+                "metrics_port": None if self._server is None
+                else self._server.port}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def cancel(self, name: str) -> Job:
+        """Cancel a job: immediately when QUEUED; at its next slice
+        boundary when RUNNING (the current chunk, if one is mid-flight in
+        another caller's slice, completes — preemption stays
+        chunk-granular)."""
+        self._check_open()
+        job = self.job(name)
+        if job.finished:
+            raise InvalidArgumentError(
+                f"Job {name!r} already finished ({job.state}).")
+        if job.state == JobState.QUEUED:
+            self._finalize(job, JobState.CANCELLED)
+        else:
+            job.cancel_requested = True
+        return job
+
+    def drain(self) -> None:
+        """Stop admitting: cancel every still-QUEUED job, let RUNNING jobs
+        finish. (`run()` afterwards completes the running set.)"""
+        self._check_open()
+        self._draining = True
+        self._log("drain")
+        for j in list(self._order):
+            if j.state == JobState.QUEUED:
+                self._finalize(j, JobState.CANCELLED)
+
+    def close(self) -> None:
+        """Release everything: running jobs' resources (their runs are
+        closed, NOT completed — submitted snapshots drain, checkpoints
+        stay restorable), the per-job metric scopes, the scheduler
+        heartbeat, the journal, and the metrics endpoint. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        for j in self._order:
+            if not j.finished:
+                self._finalize(j, JobState.CANCELLED)
+        self._log("scheduler_stop", slices=self.slices,
+                  jobs=len(self._order))
+        # the per-job labeled series die WITH the service (during its
+        # lifetime a finished job's final step/latencies stay scrapeable)
+        for j in self._order:
+            if j.scope is not None:
+                j.scope.remove_scope()
+        hooks.clear_scheduler_heartbeat()
+        if self._journal is not None:
+            self._journal.close()
+        if self._server is not None:
+            from ..telemetry.server import stop_metrics_server
+
+            stop_metrics_server()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise InvalidArgumentError("The scheduler is closed.")
+
+    # -- the scheduling loop ----------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduling decision: poll control requests, pick a job
+        under the policy, grant it ONE chunk-boundary slice. Returns True
+        when a slice was granted (False = nothing runnable — the queue is
+        drained)."""
+        self._check_open()
+        self._poll_control()
+        cands = self.runnable()
+        for j in [j for j in cands if j.cancel_requested]:
+            self._finalize(j, JobState.CANCELLED)
+        cands = self.runnable()
+        if not cands:
+            hooks.note_scheduler_heartbeat()
+            return False
+        job = self.policy.pick(cands)
+        self._slice(job)
+        hooks.note_scheduler_heartbeat(granted=True)
+        return True
+
+    def run(self, max_slices: int | None = None) -> "MeshScheduler":
+        """Drain the queue: grant slices until nothing is runnable (or
+        ``max_slices`` was granted). Returns self."""
+        granted = 0
+        while max_slices is None or granted < max_slices:
+            if not self.step():
+                break
+            granted += 1
+        return self
+
+    # -- internals ---------------------------------------------------------
+
+    def _update_queue_gauges(self) -> None:
+        hooks.note_queue_depth(
+            sum(1 for j in self._order if j.state == JobState.QUEUED),
+            sum(1 for j in self._order if j.state == JobState.RUNNING))
+
+    def _poll_control(self) -> None:
+        """CLI control channel: `tools jobs cancel|drain` drop request
+        files under ``<flight_dir>/control/``; a live scheduler consumes
+        them at slice boundaries."""
+        if self.flight_dir is None:
+            return
+        ctl = os.path.join(self.flight_dir, "control")
+        if not os.path.isdir(ctl):
+            return
+        for fname in sorted(os.listdir(ctl)):
+            path = os.path.join(ctl, fname)
+            if fname == "drain":
+                os.remove(path)
+                self._log("control", request="drain")
+                self.drain()
+            elif fname.startswith("cancel_"):
+                os.remove(path)
+                name = fname[len("cancel_"):]
+                self._log("control", request="cancel", job=name)
+                job = self.jobs.get(name)
+                if job is not None and not job.finished:
+                    self.cancel(name)
+
+    def _admit(self, job: Job) -> None:
+        """First slice grant: build the job's grid over the shared device
+        pool, run its setup under that grid, construct its `ResilientRun`.
+        All of it streams into the job's own flight recorder; the cost is
+        journaled as ``admit_s`` (the admission analog of a cold chunk)."""
+        from ..parallel.grid import init_global_grid
+
+        t0 = time.monotonic()
+        # the gauge scope first: it cannot fail, and the failure path
+        # below accounts the slice through it (a raising recorder/grid/
+        # setup must fail THIS job, never crash the scheduler)
+        job.scope = hooks.job_gauges(None, job.name)
+        if self.flight_dir is not None:
+            job.recorder = FlightRecorder(
+                os.path.join(self.flight_dir, f"job_{job.name}.jsonl"),
+                run_id=job.name)
+        prev = top.swap_global_grid(None)
+        try:
+            init_global_grid(**{"quiet": True, **job.spec.grid})
+            job.gg = top.global_grid()
+            top.retain_epoch(job.gg.epoch)
+            with use_flight_recorder(job.recorder):
+                step_local, state = job.spec.setup()
+                job.run = ResilientRun(step_local, state,
+                                       int(job.spec.nt), job.spec.run)
+        except BaseException:
+            if job.gg is not None:
+                top.release_epoch(job.gg.epoch)
+                _evict_epoch_caches(job.gg.epoch)
+                job.gg = None
+            raise
+        finally:
+            top.swap_global_grid(prev)
+        job.state = JobState.RUNNING
+        job.started_t = time.time()
+        job.admit_s = time.monotonic() - t0
+        self._update_queue_gauges()
+        self._log("job_admitted", job=job.name, admit_s=job.admit_s,
+                  epoch=int(job.gg.epoch))
+
+    def _slice(self, job: Job) -> None:
+        """Grant ``job`` one chunk-boundary slice (admitting it first if
+        this is its first grant). A raising slice FAILS the job alone."""
+        t_pick = time.monotonic()
+        wait_s = max(0.0, t_pick - (job.last_end_t or t_pick))
+        chunks0 = 0 if job.run is None else len(job.run.reports)
+        try:
+            if job.state == JobState.QUEUED:
+                self._admit(job)
+            prev = top.swap_global_grid(job.gg)
+            try:
+                with use_flight_recorder(job.recorder):
+                    more = job.run.advance()
+                # an elastic restart inside the slice re-inits the grid:
+                # track the NEW grid (and retire the dead epoch's caches)
+                cur = top._global_grid
+                if cur is not job.gg and cur is not None:
+                    old = job.gg
+                    job.gg = cur
+                    top.retain_epoch(cur.epoch)
+                    top.release_epoch(old.epoch)
+                    _evict_epoch_caches(old.epoch)
+            finally:
+                top.swap_global_grid(prev)
+        except Exception as e:
+            job.error = f"{type(e).__name__}: {e}"
+            self._account_slice(job, t_pick, wait_s, chunks0)
+            self._finalize(job, JobState.FAILED)
+            return
+        self._account_slice(job, t_pick, wait_s, chunks0)
+        if not more:
+            self._finalize(job, JobState.DONE)
+
+    def _account_slice(self, job: Job, t_pick: float, wait_s: float,
+                       chunks0: int) -> None:
+        t_end = time.monotonic()
+        slice_s = t_end - t_pick
+        job.slices += 1
+        job.slice_s_total += slice_s
+        job.wait_s_total += wait_s
+        job.last_end_t = t_end
+        self.slices += 1
+        self.policy.granted(job, slice_s)
+        # mirror the perf oracle's process-wide gauges (they flap between
+        # tenants under multiplexing) into this job's labeled copies —
+        # only when THIS slice actually ran a chunk (a fault-boundary or
+        # elastic-restart iteration dispatches none, and the global gauge
+        # still holds the PREVIOUS tenant's value) — and attribute audit
+        # findings by diffing the global family against the scheduler's
+        # baseline (slices are serialized, so the growth is this job's)
+        ran_chunk = job.run is not None and len(job.run.reports) > chunks0
+        reg = hooks.metrics_registry()
+        perf_step_s = perf_ratio = None
+        if ran_chunk and job.run.watch is not None:
+            fam = reg.get(hooks.PERF_STEP_S)
+            if fam is not None:
+                samples = fam.samples()
+                if samples:
+                    perf_step_s = samples[0][1]
+            if job.run.watch.model_step_s:
+                fam = reg.get(hooks.PERF_RATIO)
+                if fam is not None:
+                    samples = fam.samples()
+                    if samples:
+                        perf_ratio = samples[0][1]
+        total = self._audit_total()
+        findings = total - self._audit_seen
+        self._audit_seen = total
+        hooks.observe_job_slice(
+            job.scope, step=job.step, slice_s=slice_s, wait_s=wait_s,
+            perf_step_s=perf_step_s, perf_ratio=perf_ratio,
+            audit_findings=max(0.0, findings))
+        self._log("slice", job=job.name, slice=self.slices - 1,
+                  step=job.step, dur_s=slice_s, wait_s=wait_s,
+                  policy=self.policy.name)
+
+    def _finalize(self, job: Job, state: str) -> None:
+        """Move a job to a terminal state and release its resources (run
+        close → snapshot drain; epoch release → cache eviction; recorder
+        close). The job's labeled metric series survive until the
+        SCHEDULER closes — a finished tenant's final step/latencies stay
+        scrapeable across job lifetimes."""
+        if job.finished:
+            return
+        if job.run is not None:
+            if state == JobState.DONE:
+                from ..utils.timing import sync
+
+                prev = top.swap_global_grid(job.gg)
+                try:
+                    job.result = sync(job.run.state)
+                finally:
+                    top.swap_global_grid(prev)
+            job.reports = job.run.reports
+            with use_flight_recorder(job.recorder):
+                job.run.close()
+        job.state = state
+        job.finished_t = time.time()
+        if job.recorder is not None:
+            job.recorder.close()
+        if job.gg is not None:
+            top.release_epoch(job.gg.epoch)
+            _evict_epoch_caches(job.gg.epoch)
+        hooks.note_job_transition(state)
+        self._update_queue_gauges()
+        self._log("job_" + state, job=job.name, step=job.step,
+                  slices=job.slices, slice_s_total=job.slice_s_total,
+                  wait_s_total=job.wait_s_total, error=job.error)

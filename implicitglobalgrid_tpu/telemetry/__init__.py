@@ -70,7 +70,7 @@ from .recorder import (
 )
 from .registry import (
     DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricsRegistry,
-    metrics_registry, reset_metrics,
+    ScopedRegistry, metrics_registry, reset_metrics,
 )
 from .report import run_report
 from .server import (
@@ -80,8 +80,8 @@ from .server import (
 from .trace_export import export_chrome_trace
 
 __all__ = [
-    "MetricsRegistry", "Counter", "Gauge", "Histogram", "DEFAULT_BUCKETS",
-    "metrics_registry", "reset_metrics",
+    "MetricsRegistry", "ScopedRegistry", "Counter", "Gauge", "Histogram",
+    "DEFAULT_BUCKETS", "metrics_registry", "reset_metrics",
     "FlightRecorder", "start_flight_recorder", "stop_flight_recorder",
     "flight_recorder", "record_event", "record_span", "read_flight_events",
     "prometheus_snapshot", "run_report",

@@ -18,7 +18,10 @@ from .registry import metrics_registry
 __all__ = ["note_runner_cache", "account_halo_exchange",
            "observe_checkpoint", "observe_snapshot", "note_io_queue",
            "observe_reducers", "note_heartbeat", "observe_perf",
-           "note_metrics_server_port", "observe_audit"]
+           "note_metrics_server_port", "observe_audit",
+           "note_scheduler_heartbeat", "note_queue_depth", "job_gauges",
+           "observe_job_slice", "clear_scheduler_heartbeat",
+           "note_job_transition"]
 
 # Metric family names (the exported contract; see docs/observability.md).
 RUNNER_CACHE = "igg_runner_cache_total"
@@ -40,6 +43,19 @@ PERF_Z = "igg_perf_zscore"
 PERF_REGRESSIONS = "igg_perf_regressions_total"
 METRICS_SERVER_PORT = "igg_metrics_server_port"
 AUDIT_FINDINGS = "igg_audit_findings_total"
+# multi-run scheduler (service/): the per-tenant ops surface
+SCHED_HEARTBEAT_TS = "igg_scheduler_heartbeat_timestamp_seconds"
+SCHED_SLICES = "igg_scheduler_slices_total"
+QUEUE_DEPTH = "igg_jobs_queued"
+JOBS_RUNNING = "igg_jobs_running"
+JOBS_TOTAL = "igg_jobs_total"
+JOB_HEARTBEAT_TS = "igg_job_heartbeat_timestamp_seconds"
+JOB_STEP = "igg_job_step"
+JOB_PERF_STEP_S = "igg_job_perf_step_seconds"
+JOB_PERF_RATIO = "igg_job_perf_model_ratio"
+JOB_AUDIT_FINDINGS = "igg_job_audit_findings_total"
+JOB_SLICE_SECONDS = "igg_job_slice_seconds"
+JOB_WAIT_SECONDS = "igg_job_wait_seconds"
 
 
 def runner_cache_misses() -> float:
@@ -231,6 +247,91 @@ def observe_audit(report, *, program: str = "chunk",
                  crosscheck_ok=(None if report.crosscheck is None
                                 else bool(report.crosscheck.get("ok"))),
                  **extra)
+
+
+def note_scheduler_heartbeat(granted: bool = False) -> None:
+    """Stamp the multi-run scheduler's liveness (one gauge write per
+    scheduling decision — idle polls included, they prove the loop is
+    alive). When this gauge is live, `/healthz` judges THE SCHEDULER by
+    it — a single wedged job must not 503 the whole service (per-job
+    staleness is the labeled `igg_job_heartbeat_*` family). The slice
+    counter moves only when a slice was actually ``granted``, so it
+    reconciles exactly against the journal's slice events."""
+    reg = metrics_registry()
+    reg.gauge(SCHED_HEARTBEAT_TS,
+              "Wall-clock time of the scheduler's last scheduling "
+              "decision (unix seconds).").set(time.time())
+    if granted:
+        reg.counter(SCHED_SLICES,
+                    "Chunk-granular slices the scheduler has granted."
+                    ).inc(1)
+
+
+def clear_scheduler_heartbeat() -> None:
+    """Retire the scheduler heartbeat series (scheduler close): /healthz
+    falls back to judging the plain driver heartbeat again."""
+    metrics_registry().reset(SCHED_HEARTBEAT_TS)
+
+
+def note_queue_depth(queued: int, running: int) -> None:
+    """Track the scheduler's admission queue (gauges: jobs waiting for
+    their first slice, jobs currently multiplexed)."""
+    reg = metrics_registry()
+    reg.gauge(QUEUE_DEPTH,
+              "Jobs queued behind the scheduler (admitted, not yet "
+              "granted their first slice).").set(queued)
+    reg.gauge(JOBS_RUNNING,
+              "Jobs currently being multiplexed through the mesh."
+              ).set(running)
+
+
+def note_job_transition(state: str) -> None:
+    """Count one job lifecycle transition (``done``/``failed``/
+    ``cancelled``/``submitted``)."""
+    metrics_registry().counter(
+        JOBS_TOTAL, "Job lifecycle transitions by terminal state.",
+        ("state",)).inc(1, state=state)
+
+
+def job_gauges(registry, job: str):
+    """The per-job labeled families, as a `ScopedRegistry` view bound to
+    one tenant — what `/metrics` serves across job lifetimes (step,
+    heartbeat, perf, slice/wait latencies; a finished job's final values
+    stay scrapeable while the service lives) and what the scheduler
+    retires via ``remove_scope()`` when IT closes."""
+    return (registry or metrics_registry()).scoped(job=str(job))
+
+
+def observe_job_slice(scope, *, step, slice_s: float, wait_s: float,
+                      perf_step_s=None, perf_ratio=None,
+                      audit_findings: float = 0.0) -> None:
+    """Record one granted slice for one job into its scoped gauge view
+    (`job_gauges`): committed step + heartbeat, slice/wait latency
+    histograms, and the perf-oracle mirrors (the process-wide
+    ``igg_perf_*`` gauges flap between tenants under multiplexing — the
+    per-job labeled copies are the ones an operator alerts on)."""
+    scope.gauge(JOB_STEP, "Last step this job committed.").set(step)
+    scope.gauge(JOB_HEARTBEAT_TS,
+                "Wall-clock time of this job's last granted slice "
+                "(unix seconds).").set(time.time())
+    scope.histogram(JOB_SLICE_SECONDS,
+                    "Wall time of this job's granted slices (one "
+                    "chunk-boundary iteration each).").observe(slice_s)
+    scope.histogram(JOB_WAIT_SECONDS,
+                    "Time this job waited between slices (queue + other "
+                    "tenants' slices).").observe(wait_s)
+    if perf_step_s is not None:
+        scope.gauge(JOB_PERF_STEP_S,
+                    "Measured per-step execution time of this job's last "
+                    "chunk.").set(perf_step_s)
+    if perf_ratio is not None:
+        scope.gauge(JOB_PERF_RATIO,
+                    "Measured / modeled per-step time for this job."
+                    ).set(perf_ratio)
+    if audit_findings:
+        scope.counter(JOB_AUDIT_FINDINGS,
+                      "Static-analysis findings attributed to this job's "
+                      "compile-time audits.").inc(audit_findings)
 
 
 def observe_reducers(step, values: dict, *, ok: bool = True) -> None:

@@ -40,7 +40,8 @@ from ..utils.exceptions import InvalidArgumentError
 
 __all__ = ["FlightRecorder", "start_flight_recorder",
            "stop_flight_recorder", "flight_recorder", "record_event",
-           "record_span", "read_flight_events"]
+           "record_span", "read_flight_events", "use_flight_recorder",
+           "bind_thread_recorder"]
 
 _FORMAT_VERSION = 1
 
@@ -138,6 +139,19 @@ class FlightRecorder:
 
 
 _current: FlightRecorder | None = None
+_tls = threading.local()
+
+
+def bind_thread_recorder(rec: FlightRecorder | None) -> None:
+    """Pin THIS thread's events to ``rec``, overriding the process-wide
+    current recorder (None unpins). For long-lived background threads
+    that belong to one run — e.g. a job's async snapshot writer under the
+    multi-run scheduler, whose commits land while ANOTHER job's recorder
+    holds the global slot (or none does, between slices): the thread
+    captures its run's recorder once and its events stay correctly
+    attributed. Events bound to a recorder that has since closed are
+    dropped (the recorder's own closed-check), same as any late event."""
+    _tls.recorder = rec
 
 
 def start_flight_recorder(path, *, run_id: str | None = None
@@ -172,10 +186,27 @@ def flight_recorder() -> FlightRecorder | None:
     return _current
 
 
+@contextlib.contextmanager
+def use_flight_recorder(rec: FlightRecorder | None):
+    """Temporarily make ``rec`` the current recorder WITHOUT closing the
+    previous one, restoring it on exit — the multi-run scheduler's
+    per-slice routing primitive (each job's driver events stream into that
+    job's own JSONL; the outer recorder, if any, resumes afterwards).
+    ``rec=None`` silences instrumentation for the block."""
+    global _current
+    prev = _current
+    _current = rec
+    try:
+        yield rec
+    finally:
+        _current = prev
+
+
 def record_event(kind: str, **fields) -> None:
-    """Append to the current recorder; no-op (one None-check) when no
-    recorder is active — safe on hot paths."""
-    r = _current
+    """Append to this thread's bound recorder (`bind_thread_recorder`) or
+    the process-wide current one; no-op (one None-check) when neither is
+    active — safe on hot paths."""
+    r = getattr(_tls, "recorder", None) or _current
     if r is not None:
         r.event(kind, **fields)
 
@@ -184,7 +215,7 @@ def record_event(kind: str, **fields) -> None:
 def record_span(kind: str, **fields):
     """Span against the current recorder; when none is active the block
     runs untimed (no clock reads)."""
-    r = _current
+    r = getattr(_tls, "recorder", None) or _current
     if r is None:
         yield
         return

@@ -32,7 +32,8 @@ import threading
 from ..utils.exceptions import InvalidArgumentError
 
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
-           "DEFAULT_BUCKETS", "metrics_registry", "reset_metrics"]
+           "DEFAULT_BUCKETS", "metrics_registry", "reset_metrics",
+           "ScopedRegistry"]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -219,6 +220,16 @@ class MetricsRegistry:
                 out.append(rec)
         return out
 
+    def scoped(self, **labels) -> "ScopedRegistry":
+        """A view of this registry that namespaces every family it touches
+        under fixed extra labels — the PER-JOB namespacing the multi-run
+        scheduler uses (``reg.scoped(job="run42")``): a family registered
+        through the view carries the scope's label names appended to its
+        own, and every sample call fills them in automatically. Series
+        from different scopes coexist in ONE family (one exported metric
+        name, label-separated), exactly how Prometheus models tenants."""
+        return ScopedRegistry(self, labels)
+
     def reset(self, name: str | None = None) -> None:
         """Zero every series of family ``name`` (or of ALL families).
         Registrations survive, so handles cached by callers stay valid."""
@@ -230,6 +241,112 @@ class MetricsRegistry:
                 return
             for fam in self._families.values():
                 fam._series.clear()
+
+
+class _ScopedFamily:
+    """A family handle that injects the scope's labels into every call.
+    Mirrors the Counter/Gauge/Histogram sample surface (`inc`/`set`/`add`/
+    `observe`/`value`); the underlying family is shared across scopes."""
+
+    def __init__(self, family: _Family, labels: dict):
+        self._fam = family
+        self._labels = labels
+
+    @property
+    def name(self) -> str:
+        return self._fam.name
+
+    def _merge(self, labels: dict) -> dict:
+        overlap = set(labels) & set(self._labels)
+        if overlap:
+            raise InvalidArgumentError(
+                f"Metric {self._fam.name}: labels {sorted(overlap)} are "
+                "fixed by the registry scope and cannot be overridden.")
+        return {**labels, **self._labels}
+
+    def inc(self, n: float = 1, **labels) -> None:
+        self._fam.inc(n, **self._merge(labels))
+
+    def set(self, v: float, **labels) -> None:
+        self._fam.set(v, **self._merge(labels))
+
+    def add(self, n: float, **labels) -> None:
+        self._fam.add(n, **self._merge(labels))
+
+    def observe(self, v: float, **labels) -> None:
+        self._fam.observe(v, **self._merge(labels))
+
+    def value(self, **labels) -> float:
+        return self._fam.value(**self._merge(labels))
+
+
+class ScopedRegistry:
+    """A label-namespaced view of a `MetricsRegistry` (see
+    `MetricsRegistry.scoped`). Registration appends the scope's label
+    names to the family's own (idempotently against other scopes of the
+    SAME label-name set — two jobs share one family); sample calls fill
+    the scope's values in. ``remove_scope()`` drops exactly this scope's
+    series from every family it touched — how the scheduler retires a
+    finished job's gauges without zeroing the neighbors'."""
+
+    def __init__(self, registry: MetricsRegistry, labels: dict):
+        if not labels:
+            raise InvalidArgumentError(
+                "ScopedRegistry needs at least one scope label "
+                "(e.g. job='run42').")
+        for ln in labels:
+            if not _LABEL_RE.match(ln or ""):
+                raise InvalidArgumentError(
+                    f"Invalid scope label name {ln!r}.")
+        self.registry = registry
+        self.labels = {k: str(v) for k, v in labels.items()}
+        self._touched: set = set()
+
+    def _scoped(self, fam: _Family) -> _ScopedFamily:
+        self._touched.add(fam.name)
+        return _ScopedFamily(fam, self.labels)
+
+    def _labelnames(self, labelnames: tuple) -> tuple:
+        clash = set(labelnames) & set(self.labels)
+        if clash:
+            raise InvalidArgumentError(
+                f"Label name(s) {sorted(clash)} collide with the scope's.")
+        return tuple(labelnames) + tuple(self.labels)
+
+    def counter(self, name: str, help: str = "",
+                labelnames: tuple = ()) -> _ScopedFamily:
+        return self._scoped(self.registry.counter(
+            name, help, self._labelnames(labelnames)))
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: tuple = ()) -> _ScopedFamily:
+        return self._scoped(self.registry.gauge(
+            name, help, self._labelnames(labelnames)))
+
+    def histogram(self, name: str, help: str = "", labelnames: tuple = (),
+                  buckets: tuple = DEFAULT_BUCKETS) -> _ScopedFamily:
+        return self._scoped(self.registry.histogram(
+            name, help, self._labelnames(labelnames), buckets=buckets))
+
+    def get(self, name: str):
+        fam = self.registry.get(name)
+        return None if fam is None else self._scoped(fam)
+
+    def remove_scope(self) -> None:
+        """Delete every series carrying THIS scope's label values from the
+        families this view touched (other scopes' series survive)."""
+        items = sorted(self.labels.items())
+        with self.registry._lock:
+            for name in self._touched:
+                fam = self.registry._families.get(name)
+                if fam is None:
+                    continue
+                pos = [fam.labelnames.index(ln) for ln, _ in items
+                       if ln in fam.labelnames]
+                vals = [v for ln, v in items if ln in fam.labelnames]
+                for k in [k for k in fam._series
+                          if [k[p] for p in pos] == vals]:
+                    del fam._series[k]
 
 
 _default = MetricsRegistry()

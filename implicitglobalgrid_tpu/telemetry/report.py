@@ -140,7 +140,11 @@ def run_report(source, *, run_id: str | None = None,
     ``source`` is a flight-recorder JSONL path, a DIRECTORY of per-process
     streams (the ``flight_p<i>.jsonl`` convention — aggregated and clock-
     aligned via `telemetry.aggregate.aggregate_flight` first), or an
-    iterable of already-parsed event dicts. ``run_id`` selects a run when
+    iterable of already-parsed event dicts. A directory holding a
+    MULTI-RUN SCHEDULER journal (``scheduler.jsonl``) returns the
+    SERVICE record instead — the interleaved schedule plus each
+    tenant's own run report (`service.service_report`; ``run_id`` does
+    not apply there — jobs are selected by name in the record). ``run_id`` selects a run when
     the file holds several (default: the LAST run that appears; for a
     directory, the single run present — several raise). ``trace_dir``
     merges a profiler capture's `overlap_stats` and `op_breakdown`;
@@ -158,6 +162,15 @@ def run_report(source, *, run_id: str | None = None,
     agg = None
     if isinstance(source, (str, os.PathLike)) \
             and os.path.isdir(os.fspath(source)):
+        from ..service.report import is_service_dir, service_report
+
+        if is_service_dir(source):
+            # a MeshScheduler flight directory (scheduler.jsonl + one
+            # stream per job): the unified record is the SERVICE view —
+            # the interleaved schedule plus each tenant's own run report
+            # (the per-process aggregate below would refuse the mixed run
+            # ids, rightly: jobs are tenants, not mesh processes)
+            return service_report(source)
         from .aggregate import aggregate_flight
 
         agg = aggregate_flight(source, run_id=run_id)

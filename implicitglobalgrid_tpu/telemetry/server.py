@@ -32,7 +32,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..utils.exceptions import InvalidArgumentError
 from .export import prometheus_snapshot
-from .hooks import HEARTBEAT_STEP, HEARTBEAT_TS
+from .hooks import (
+    HEARTBEAT_STEP, HEARTBEAT_TS, JOB_HEARTBEAT_TS, SCHED_HEARTBEAT_TS,
+)
 from .registry import metrics_registry
 
 __all__ = ["MetricsServer", "start_metrics_server", "stop_metrics_server",
@@ -93,25 +95,41 @@ class MetricsServer:
 
         note_metrics_server_port(self.port)
 
+    def _gauge_value(self, name):
+        fam = self.registry.get(name)
+        if fam is not None:
+            samples = fam.samples()
+            if samples:
+                return samples[0][1]
+        return None
+
     def _healthz(self):
-        """(status_code, record): heartbeat age from the driver gauge."""
-        age = step = None
-        fam = self.registry.get(HEARTBEAT_TS)
+        """(status_code, record): heartbeat age. When a scheduler owns the
+        mesh its heartbeat (`igg_scheduler_heartbeat_timestamp_seconds`)
+        is THE liveness — a single wedged job must not 503 the whole
+        service — and per-job staleness moves to the labeled
+        `igg_job_heartbeat_timestamp_seconds` gauges, echoed here as
+        ``job_ages_s``. Plain supervised runs keep the driver gauge."""
+        now = time.time()
+        source = "driver"
+        ts = self._gauge_value(SCHED_HEARTBEAT_TS)
+        if ts is not None:
+            source = "scheduler"
+        else:
+            ts = self._gauge_value(HEARTBEAT_TS)
+        age = None if ts is None else now - ts
+        step = self._gauge_value(HEARTBEAT_STEP)
+        rec = {"ok": True, "heartbeat_age_s": age, "step": step,
+               "max_age_s": self.healthz_max_age_s, "source": source}
+        fam = self.registry.get(JOB_HEARTBEAT_TS)
         if fam is not None:
-            samples = fam.samples()
-            if samples:
-                age = time.time() - samples[0][1]
-        fam = self.registry.get(HEARTBEAT_STEP)
-        if fam is not None:
-            samples = fam.samples()
-            if samples:
-                step = samples[0][1]
-        ok = True
+            jobs = {lbl.get("job", "?"): now - v
+                    for lbl, v in fam.samples()}
+            if jobs:
+                rec["job_ages_s"] = dict(sorted(jobs.items()))
         if self.healthz_max_age_s is not None:
-            ok = age is not None and age <= self.healthz_max_age_s
-        return (200 if ok else 503), {
-            "ok": ok, "heartbeat_age_s": age, "step": step,
-            "max_age_s": self.healthz_max_age_s}
+            rec["ok"] = age is not None and age <= self.healthz_max_age_s
+        return (200 if rec["ok"] else 503), rec
 
     def close(self) -> None:
         self._httpd.shutdown()
@@ -130,6 +148,7 @@ class MetricsServer:
 
 
 _current: MetricsServer | None = None
+_refs = 0
 _lock = threading.Lock()
 
 
@@ -137,31 +156,59 @@ def start_metrics_server(port: int = 0, *, host: str = "127.0.0.1",
                          registry=None,
                          healthz_max_age_s: float | None = None
                          ) -> MetricsServer:
-    """Start THE process metrics server (one per process — a second start
-    without a stop raises; scrapers address one stable port). ``port=0``
-    binds an ephemeral port; the ACTUAL port is the returned server's
-    ``.port`` and the ``igg_metrics_server_port`` gauge (0 again after
-    stop). Binds ``127.0.0.1`` unless ``host`` says otherwise (see the
-    module docstring's security note)."""
-    global _current
+    """Start THE process metrics server, or ATTACH to the one already
+    running (one endpoint per process; starts are refcounted — each
+    `start_metrics_server` is balanced by one `stop_metrics_server`, and
+    the socket closes only when the last holder stops). Attachment is what
+    lets a scheduler-owned long-lived endpoint persist across jobs while a
+    concurrent `run_resilient(metrics_port=...)` inside it still
+    'starts' its server: the second start joins the first instead of
+    failing to bind. An attach must be compatible: ``port`` 0 or the
+    running server's own, same ``host``, same ``registry`` — a genuinely
+    conflicting request still raises. The FIRST start's
+    ``healthz_max_age_s`` wins (attachers observe, the owner configures).
+
+    ``port=0`` binds an ephemeral port; the ACTUAL port is the returned
+    server's ``.port`` and the ``igg_metrics_server_port`` gauge (0 again
+    after the last stop). Binds ``127.0.0.1`` unless ``host`` says
+    otherwise (see the module docstring's security note)."""
+    global _current, _refs
     with _lock:
         if _current is not None:
-            raise InvalidArgumentError(
-                f"A metrics server is already running on "
-                f"{_current.host}:{_current.port}; stop_metrics_server() "
-                "first.")
+            if int(port) not in (0, _current.port):
+                raise InvalidArgumentError(
+                    f"A metrics server is already running on "
+                    f"{_current.host}:{_current.port}; a second start can "
+                    f"attach (port=0 or {_current.port}) but not rebind "
+                    f"to port {int(port)}.")
+            if host != _current.host:
+                raise InvalidArgumentError(
+                    f"A metrics server is already running on host "
+                    f"{_current.host}; cannot attach with host {host!r}.")
+            if registry is not None and registry is not _current.registry:
+                raise InvalidArgumentError(
+                    "A metrics server is already running over a different "
+                    "registry; stop it before serving another.")
+            _refs += 1
+            return _current
         _current = MetricsServer(port, host=host, registry=registry,
                                  healthz_max_age_s=healthz_max_age_s)
+        _refs = 1
         return _current
 
 
 def stop_metrics_server() -> None:
-    """Stop the process metrics server (no-op when none is running)."""
-    global _current
+    """Release one hold on the process metrics server; the socket closes
+    when the LAST holder releases (no-op when none is running)."""
+    global _current, _refs
     with _lock:
-        if _current is not None:
+        if _current is None:
+            return
+        _refs -= 1
+        if _refs <= 0:
             _current.close()
             _current = None
+            _refs = 0
 
 
 def metrics_server() -> MetricsServer | None:

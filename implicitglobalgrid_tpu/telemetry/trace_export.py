@@ -80,6 +80,30 @@ def _args(e: dict, skip=("t", "t_mono", "t_offset", "kind", "run", "pid",
     return {k: v for k, v in e.items() if k not in skip}
 
 
+def _span_start(e: dict) -> float | None:
+    """Earliest timeline point an event reaches back to (its stamp is its
+    END; spans carry their duration before it). None for unstamped
+    events."""
+    if "t" not in e:
+        return None
+    t = float(e["t"])
+    for f in ("dur_s", "exec_s"):
+        t -= float(e.get(f, 0.0) or 0.0)
+    t -= float(e.get("build_s", 0.0) or 0.0) if "exec_s" in e else 0.0
+    return t
+
+
+def _track_meta(trace: list, pid: int, name: str) -> None:
+    """Track metadata: one Perfetto process row per pid, with the driver
+    and io-writer threads named."""
+    trace.append({"ph": "M", "pid": pid, "name": "process_name",
+                  "args": {"name": name}})
+    trace.append({"ph": "M", "pid": pid, "tid": _TID_DRIVER,
+                  "name": "thread_name", "args": {"name": "driver"}})
+    trace.append({"ph": "M", "pid": pid, "tid": _TID_IO,
+                  "name": "thread_name", "args": {"name": "io-writer"}})
+
+
 def export_chrome_trace(source, out=None, *, run_id: str | None = None):
     """Render ``source`` as Chrome trace-event JSON.
 
@@ -94,16 +118,7 @@ def export_chrome_trace(source, out=None, *, run_id: str | None = None):
     if not events:
         raise InvalidArgumentError("export_chrome_trace: no events.")
     # rebase to the earliest point on the timeline — span STARTS included
-    # (an event's stamp is its END; its duration reaches back before it)
-    starts = []
-    for e in events:
-        if "t" not in e:
-            continue
-        t = float(e["t"])
-        for f in ("dur_s", "exec_s"):
-            t -= float(e.get(f, 0.0) or 0.0)
-        t -= float(e.get("build_s", 0.0) or 0.0) if "exec_s" in e else 0.0
-        starts.append(t)
+    starts = [s for s in map(_span_start, events) if s is not None]
     t0 = min(starts)
 
     def us(t: float) -> float:
@@ -112,21 +127,43 @@ def export_chrome_trace(source, out=None, *, run_id: str | None = None):
     trace: list = []
     procs = sorted({int(e.get("proc", 0)) for e in events})
     for p in procs:
-        trace.append({"ph": "M", "pid": p, "name": "process_name",
-                      "args": {"name": f"igg process {p}"}})
-        trace.append({"ph": "M", "pid": p, "tid": _TID_DRIVER,
-                      "name": "thread_name", "args": {"name": "driver"}})
-        trace.append({"ph": "M", "pid": p, "tid": _TID_IO,
-                      "name": "thread_name",
-                      "args": {"name": "io-writer"}})
+        _track_meta(trace, p, f"igg process {p}")
 
     wire_cum = {p: 0 for p in procs}
     for e in events:
-        kind = e.get("kind")
-        if kind is None or "t" not in e:
+        if "t" not in e or e.get("kind") is None:
             continue
-        p = int(e.get("proc", 0))
-        t = float(e["t"])
+        _emit_event(trace, e, int(e.get("proc", 0)), us, wire_cum)
+
+    doc = {
+        "traceEvents": trace,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "implicitglobalgrid_tpu flight recorder",
+            "processes": procs,
+        },
+    }
+    if agg is not None:
+        doc["otherData"]["run_id"] = agg.get("run_id")
+        doc["otherData"]["offsets"] = {
+            str(k): v for k, v in (agg.get("offsets") or {}).items()}
+        doc["otherData"]["align"] = agg.get("align")
+    if out is None:
+        return doc
+    out = os.fspath(out)
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    return out
+
+
+def _emit_event(trace: list, e: dict, p: int, us, wire_cum: dict) -> None:
+    """Render ONE flight event onto track ``p`` (trace pid). Shared by the
+    per-process export above and the per-JOB export of the multi-run
+    scheduler (`service.export_service_trace` — there ``p`` is the job's
+    track, not a jax process index)."""
+    kind = e.get("kind")
+    t = float(e["t"])
+    if kind is not None:
         if kind == "chunk":
             build = float(e.get("build_s", 0.0) or 0.0)
             ex = float(e.get("exec_s", 0.0) or 0.0)
@@ -190,23 +227,3 @@ def export_chrome_trace(source, out=None, *, run_id: str | None = None):
             trace.append({"ph": "i", "pid": p, "tid": _TID_DRIVER,
                           "cat": "run", "name": kind, "ts": us(t),
                           "s": "t", "args": _args(e)})
-
-    doc = {
-        "traceEvents": trace,
-        "displayTimeUnit": "ms",
-        "otherData": {
-            "source": "implicitglobalgrid_tpu flight recorder",
-            "processes": procs,
-        },
-    }
-    if agg is not None:
-        doc["otherData"]["run_id"] = agg.get("run_id")
-        doc["otherData"]["offsets"] = {
-            str(k): v for k, v in (agg.get("offsets") or {}).items()}
-        doc["otherData"]["align"] = agg.get("align")
-    if out is None:
-        return doc
-    out = os.fspath(out)
-    with open(out, "w", encoding="utf-8") as f:
-        json.dump(doc, f)
-    return out

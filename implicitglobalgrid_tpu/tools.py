@@ -262,6 +262,14 @@ def _cli(argv=None) -> int:
       dump host-only (``--hlo``, optionally against a ``--contract``
       JSON). EXITS 1 when any error-severity finding survives — the CI
       hook that makes the wire contract gate itself.
+    - ``jobs submit|list|status|cancel|drain`` — the multi-run
+      scheduler's operator surface (`service.MeshScheduler`,
+      docs/service.md): ``submit QUEUE.json`` runs a JSON-described job
+      queue through one persistent-mesh scheduler (exit 1 unless every
+      job finishes), ``list``/``status`` inspect a service flight
+      directory post-hoc from its journal, ``cancel``/``drain`` file
+      control requests a LIVE scheduler consumes at its next
+      chunk-granular slice boundary.
     """
     import argparse
     import json
@@ -272,6 +280,49 @@ def _cli(argv=None) -> int:
         prog="python -m implicitglobalgrid_tpu.tools",
         description="implicitglobalgrid_tpu operator tools")
     sub = ap.add_subparsers(dest="cmd", required=True)
+    jp = sub.add_parser(
+        "jobs", help="multi-run scheduler: submit a job queue, inspect "
+                     "or control a service flight directory")
+    jobs_sub = jp.add_subparsers(dest="jobs_cmd", required=True)
+    js = jobs_sub.add_parser(
+        "submit", help="run a JSON-described job queue through one "
+                       "MeshScheduler (exit 1 unless every job finishes)")
+    js.add_argument("spec", help="queue JSON: {policy?, jobs: [{name, "
+                                 "model, nt, grid?, dtype?, priority?, "
+                                 "deadline_s?, run?}]}")
+    js.add_argument("--flight-dir", default=None,
+                    help="journal + per-job flight JSONLs land here "
+                         "(enables list/status/report afterwards)")
+    js.add_argument("--policy", default=None,
+                    help="override the spec's policy (fifo | round_robin "
+                         "| fair)")
+    js.add_argument("--metrics-port", type=int, default=None,
+                    help="serve the scheduler-owned /metrics + /healthz "
+                         "for the duration (0 = ephemeral)")
+    js.add_argument("--cpu", action="store_true",
+                    help="run on the 8-device virtual CPU mesh (the "
+                         "bench scripts' convention)")
+    js.add_argument("--json", action="store_true")
+    jl = jobs_sub.add_parser(
+        "list", help="jobs of a service flight directory (post-hoc, "
+                     "from the journal alone)")
+    jl.add_argument("flight_dir")
+    jl.add_argument("--json", action="store_true")
+    jst = jobs_sub.add_parser(
+        "status", help="one job's record (exit 3 when unknown)")
+    jst.add_argument("flight_dir")
+    jst.add_argument("name")
+    jst.add_argument("--indent", type=int, default=2)
+    jc = jobs_sub.add_parser(
+        "cancel", help="file a cancel request a LIVE scheduler consumes "
+                       "at its next slice boundary (exit 3 unknown job, "
+                       "4 already finished)")
+    jc.add_argument("flight_dir")
+    jc.add_argument("name")
+    jd = jobs_sub.add_parser(
+        "drain", help="file a drain request: cancel queued jobs, finish "
+                      "running ones")
+    jd.add_argument("flight_dir")
     rp = sub.add_parser("report", help="unified run report from a "
                                        "flight-recorder JSONL stream")
     rp.add_argument("jsonl", help="flight-recorder .jsonl file")
@@ -406,6 +457,8 @@ def _cli(argv=None) -> int:
 
     if args.cmd == "audit":
         return _cli_audit(args)
+    if args.cmd == "jobs":
+        return _cli_jobs(args)
 
     from .telemetry import prometheus_snapshot, run_report
 
@@ -473,10 +526,19 @@ def _cli(argv=None) -> int:
         print(json.dumps(summary, indent=args.indent, default=str))
         return 0
     if args.cmd == "trace":
+        from .service.report import is_service_dir
         from .telemetry import export_chrome_trace
 
-        path = export_chrome_trace(_agg_source(), args.out,
-                                   run_id=args.run_id)
+        src = _agg_source()
+        if isinstance(src, str) and is_service_dir(src):
+            # a MeshScheduler flight dir: jobs are tenants, not mesh
+            # processes — render one Perfetto track per job instead of
+            # refusing the mixed run ids
+            from .service import export_service_trace
+
+            print(export_service_trace(src, args.out))
+            return 0
+        path = export_chrome_trace(src, args.out, run_id=args.run_id)
         print(path)
         return 0
     if args.cmd == "stragglers":
@@ -532,6 +594,144 @@ def _cli(argv=None) -> int:
     rep = run_report(args.jsonl, run_id=args.run_id, trace_dir=args.trace,
                      include_metrics=not args.no_metrics)
     print(json.dumps(rep, indent=args.indent, default=str))
+    return 0
+
+
+def _cli_jobs(args) -> int:
+    """The ``jobs`` subcommand group: the multi-run scheduler's operator
+    surface (`docs/service.md`).
+
+    - ``submit QUEUE.json``: build a `service.MeshScheduler`, submit every
+      described job (built-in models by name, grids per job), drain the
+      queue, print the outcome. Exit 0 only when EVERY job finished
+      (``done``); 1 otherwise — the CI-able batch entry point.
+    - ``list DIR`` / ``status DIR NAME``: post-hoc queue inspection from
+      the journal alone (a service that died hours ago still answers).
+    - ``cancel DIR NAME`` / ``drain DIR``: file control requests under
+      ``DIR/control/`` that a LIVE scheduler consumes at its next slice
+      boundary (chunk-granular preemption — nothing is killed mid-chunk).
+    """
+    import json
+    import os
+
+    from .service.report import read_journal, service_report
+    from .utils.exceptions import InvalidArgumentError
+
+    if args.jobs_cmd == "submit":
+        if args.cpu:
+            # must precede any jax device use (the bench scripts' idiom)
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        from .runtime.spec import RunSpec
+        from .service import JobSpec, JobState, MeshScheduler, \
+            builtin_setup
+
+        with open(args.spec, encoding="utf-8") as f:
+            queue = json.load(f)
+        if not isinstance(queue, dict) or not queue.get("jobs"):
+            raise InvalidArgumentError(
+                f"{args.spec}: expected {{'jobs': [...]}} with at least "
+                "one job.")
+        policy = args.policy or queue.get("policy", "fifo")
+        sched = MeshScheduler(policy=policy, flight_dir=args.flight_dir,
+                              metrics_port=args.metrics_port)
+        try:
+            for i, rec in enumerate(queue["jobs"]):
+                rec = dict(rec)
+                missing = [k for k in ("name", "model", "nt")
+                           if k not in rec]
+                if missing:
+                    raise InvalidArgumentError(
+                        f"{args.spec}: job #{i} is missing required "
+                        f"key(s) {missing}.")
+                run = dict(rec.pop("run", {}) or {})
+                # runner caching across chunks needs a key; the job name
+                # is the natural one
+                run.setdefault("key", ("jobs_cli", rec.get("name")))
+                spec = JobSpec(
+                    name=rec.pop("name"),
+                    setup=builtin_setup(rec.pop("model"),
+                                        rec.pop("dtype", "float32")),
+                    nt=rec.pop("nt"),
+                    grid=dict(rec.pop("grid", {}) or {}),
+                    run=RunSpec(**run),
+                    priority=rec.pop("priority", 1),
+                    deadline_s=rec.pop("deadline_s", None))
+                if rec:  # a typo'd knob must fail, not silently default
+                    raise InvalidArgumentError(
+                        f"{args.spec}: job {spec.name!r} has unknown "
+                        f"key(s) {sorted(rec)} (supervised-run knobs "
+                        "belong inside 'run').")
+                sched.submit(spec)
+            sched.run()
+            status = sched.status()
+        finally:
+            sched.close()
+        ok = all(j["state"] == JobState.DONE for j in status["jobs"])
+        if args.json:
+            print(json.dumps({"ok": ok, **status}, default=str))
+        else:
+            for j in status["jobs"]:
+                err = f"  ({j['error']})" if j.get("error") else ""
+                print(f"{j['name']}: {j['state']} step {j['step']}/"
+                      f"{j['nt']} in {j['slices']} slice(s){err}")
+        return 0 if ok else 1
+
+    if args.jobs_cmd == "list":
+        rep = service_report(args.flight_dir, include_jobs=False)
+        if args.json:
+            print(json.dumps(rep, default=str))
+        else:
+            for name, j in rep["jobs"].items():
+                print(f"{name:<20} {j['state']:<10} "
+                      f"step {j.get('step') or 0:>8}  "
+                      f"slices {j['slices']:>5}  "
+                      f"mesh {j['slice_s_total']:.3f}s "
+                      f"({100 * j['mesh_share']:.0f}%)")
+        return 0
+    if args.jobs_cmd == "status":
+        rep = service_report(args.flight_dir)
+        job = rep["jobs"].get(args.name)
+        if job is None:
+            print(json.dumps({"error": f"no job named {args.name!r}",
+                              "have": list(rep["jobs"])}))
+            return 3
+        print(json.dumps(job, indent=args.indent, default=str))
+        return 0
+
+    # control-channel commands: validated against the journal, consumed
+    # by the live scheduler's _poll_control at its next slice boundary
+    ctl = os.path.join(args.flight_dir, "control")
+    if args.jobs_cmd == "cancel":
+        jobs = service_report(args.flight_dir,
+                              include_jobs=False)["jobs"]
+        job = jobs.get(args.name)
+        if job is None:
+            print(json.dumps({"error": f"no job named {args.name!r}",
+                              "have": list(jobs)}))
+            return 3
+        if job["state"] not in ("queued", "running"):
+            print(json.dumps({"error": f"job {args.name!r} already "
+                                       f"{job['state']}"}))
+            return 4
+        os.makedirs(ctl, exist_ok=True)
+        path = os.path.join(ctl, f"cancel_{args.name}")
+        with open(path, "w", encoding="utf-8"):
+            pass
+        print(json.dumps({"requested": "cancel", "job": args.name,
+                          "control": path}))
+        return 0
+    # drain
+    read_journal(args.flight_dir)  # validates the directory
+    os.makedirs(ctl, exist_ok=True)
+    path = os.path.join(ctl, "drain")
+    with open(path, "w", encoding="utf-8"):
+        pass
+    print(json.dumps({"requested": "drain", "control": path}))
     return 0
 
 

@@ -83,8 +83,15 @@ def _sync_strong(tree):
     key = (gg.epoch, tuple(sig))
     fn = _drain_cache.get(key)
     if fn is None:
-        if _drain_cache and next(iter(_drain_cache))[0] != gg.epoch:
-            _drain_cache.clear()
+        if _drain_cache:
+            # dead-epoch eviction only: scheduler-retained grids
+            # (`topology.retain_epoch`) keep their drains warm across
+            # context switches
+            from ..parallel.topology import live_epochs
+
+            live = live_epochs()
+            for k in [k for k in _drain_cache if k[0] not in live]:
+                del _drain_cache[k]
         fn = _drain_fn(gg, sig)
         _drain_cache[key] = fn
     np.asarray(fn(*leaves))  # concrete fetch = the ordering guarantee
@@ -137,7 +144,11 @@ def _device_barrier() -> None:
     key = gg.epoch
     fn = _probe_cache.get(key)
     if fn is None:
-        _probe_cache.clear()
+        from ..parallel.topology import live_epochs
+
+        live = live_epochs()
+        for k in [k for k in _probe_cache if k not in live]:
+            del _probe_cache[k]
 
         def probe(x):
             s = x

@@ -31,9 +31,12 @@ def _clean_grid():
     """Ensure no grid state leaks between tests (each reference test file
     re-inits/finalizes repeatedly with `init_MPI=false` — same hygiene here)."""
     import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.parallel import topology
 
     if igg.grid_is_initialized():
         igg.finalize_global_grid()
+    topology._retained_epochs.clear()  # scheduler-held grids don't leak
     yield
     if igg.grid_is_initialized():
         igg.finalize_global_grid()
+    topology._retained_epochs.clear()

@@ -35,7 +35,12 @@ def test_novis_example(tmp_path):
     assert abs(float(m.group(1)) - 6.457611) < 5e-4
 
 
+@pytest.mark.slow
 def test_vis_example(tmp_path):
+    """slow (tier-1 budget, ISSUE 8 trim): the vis flavor adds a ~12 s
+    subprocess on top of the novis smoke, which stays tier-1 as the
+    diffusion example's fast representative (gather-for-vis itself is
+    unit-tested in test_gather.py)."""
     out = _run("diffusion3D_multixpu.py", tmp_path)
     wrote = [p.name for p in tmp_path.iterdir()]
     assert any(n.startswith("diffusion3D") for n in wrote), (out, wrote)
@@ -58,7 +63,12 @@ def test_advanced_modes_example(tmp_path):
     assert "overlap[" in out
 
 
+@pytest.mark.slow
 def test_stokes_example(tmp_path):
+    """slow (tier-1 budget, ISSUE 8 trim): a ~9 s subprocess smoke over a
+    model family whose step/exchange/deep-halo behaviors all have
+    dedicated tier-1 suites; novis + acoustic remain the fast example
+    representatives."""
     out = _run("stokes3D_multixpu.py", tmp_path)
     assert "PT iterations" in out
     # residuals must DROP across the printed checks

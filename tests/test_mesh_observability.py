@@ -476,9 +476,18 @@ def test_metrics_server_serves_prometheus_and_healthz():
         assert rec["step"] == 70 and 0 <= rec["heartbeat_age_s"] < 60
         status, _, _ = _get(f"http://127.0.0.1:{srv.port}/metrics")
         assert status == 200  # snapshot includes the heartbeat gauges now
-        # a second server without stopping the first is refused
+        # a second start ATTACHES to the running server (refcounted —
+        # the scheduler-owned-endpoint contract, ISSUE 8); a genuinely
+        # conflicting explicit port still refuses
+        assert igg.start_metrics_server(0) is srv
+        assert igg.start_metrics_server(srv.port) is srv
         with pytest.raises(InvalidArgumentError, match="already running"):
-            igg.start_metrics_server(0)
+            igg.start_metrics_server(srv.port + 1)
+        igg.stop_metrics_server()  # balance the two attaches...
+        igg.stop_metrics_server()
+        assert igg.metrics_server() is srv  # ...owner's hold remains
+        status, _, _ = _get(f"http://127.0.0.1:{srv.port}/metrics")
+        assert status == 200
     finally:
         igg.stop_metrics_server()
     assert igg.metrics_server() is None

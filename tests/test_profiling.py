@@ -6,6 +6,7 @@ reference's structure-the-streams-for-Nsight approach,
 import os
 
 import numpy as np
+import pytest
 
 import implicitglobalgrid_tpu as igg
 
@@ -249,7 +250,12 @@ def test_comm_classified_by_op_kind(tmp_path):
     assert s["comm_us"] == 0.0 and abs(s["compute_us"] - 2.0) < 1e-9
 
 
+@pytest.mark.slow
 def test_trace_and_annotate(tmp_path):
+    """slow (tier-1 budget, ISSUE 8 trim): a REAL profiler capture costs
+    ~18 s on the shared box; the decoder/arithmetic paths it feeds keep
+    their fast synthetic-capture coverage above (xplane decoder,
+    overlap_stats, op_breakdown, host fallbacks)."""
     igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2, periodx=1, quiet=True)
     A = igg.device_put_g(np.ones((8, 8, 8), np.float32))
     with igg.trace(str(tmp_path)):

@@ -228,6 +228,75 @@ def test_audit_cli_argument_validation():
         _cli(["audit", "diffusion3d", "--hlo", "x.txt"])  # both
 
 
+@pytest.mark.service
+def test_jobs_cli_submit_list_status_control(tmp_path, capsys):
+    """`tools jobs` smoke, exit codes included: submit runs a
+    JSON-described queue through one scheduler (rc 1 when a job fails —
+    here an unsatisfiable grid fails at admission while the good job
+    completes), list/status answer post-hoc from the journal (rc 3 for
+    an unknown name), cancel/drain file control requests (rc 4 for an
+    already-finished job)."""
+    import json
+
+    from implicitglobalgrid_tpu.tools import _cli
+
+    fd = str(tmp_path / "fd")
+    queue = tmp_path / "queue.json"
+    queue.write_text(json.dumps({"policy": "fifo", "jobs": [
+        {"name": "ok", "model": "diffusion3d", "dtype": "float64",
+         "nt": 4, "grid": {"nx": 6, "ny": 6, "nz": 6, "dimx": 2,
+                           "dimy": 2, "dimz": 1},
+         "run": {"nt_chunk": 2}},
+        # 16 shards > the 8-device pool: fails at admission, no compile
+        {"name": "toobig", "model": "diffusion3d", "nt": 4,
+         "grid": {"nx": 6, "ny": 6, "nz": 6, "dimx": 16, "dimy": 1,
+                  "dimz": 1}},
+    ]}))
+    rc = _cli(["jobs", "submit", str(queue), "--flight-dir", fd,
+               "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1  # one job failed -> the batch entry is not ok
+    assert out["ok"] is False
+    by_name = {j["name"]: j for j in out["jobs"]}
+    assert by_name["ok"]["state"] == "done"
+    assert by_name["ok"]["step"] == 4
+    assert by_name["toobig"]["state"] == "failed"
+    assert "InvalidArgumentError" in by_name["toobig"]["error"]
+
+    assert _cli(["jobs", "list", fd]) == 0
+    listing = capsys.readouterr().out
+    assert "ok" in listing and "toobig" in listing
+    assert _cli(["jobs", "status", fd, "ok"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["state"] == "done"
+    assert rec["report"]["steps"]["completed"] == 4
+    assert _cli(["jobs", "status", fd, "nope"]) == 3
+    capsys.readouterr()
+    # control requests: unknown -> 3, finished -> 4, drain files its
+    # request for a live scheduler to consume
+    assert _cli(["jobs", "cancel", fd, "nope"]) == 3
+    assert _cli(["jobs", "cancel", fd, "ok"]) == 4
+    assert _cli(["jobs", "drain", fd]) == 0
+    capsys.readouterr()
+    import os
+
+    assert os.path.exists(os.path.join(fd, "control", "drain"))
+    # queue JSON validation: a typo'd/misplaced knob must fail loudly,
+    # never silently run with defaults
+    from implicitglobalgrid_tpu.utils.exceptions import (
+        InvalidArgumentError,
+    )
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"jobs": [
+        {"name": "x", "model": "diffusion3d", "nt": 4, "nt_chunk": 2}]}))
+    with pytest.raises(InvalidArgumentError, match="unknown key"):
+        _cli(["jobs", "submit", str(bad)])
+    bad.write_text(json.dumps({"jobs": [{"name": "x", "nt": 4}]}))
+    with pytest.raises(InvalidArgumentError, match="missing required"):
+        _cli(["jobs", "submit", str(bad)])
+
+
 def test_layout_override_coordinate_helpers():
     """x_g must honor layout= for the same ambiguous block the nx_g test
     documents: a (8,4,4) LOCAL block on a dims=(2,1,1) grid reads as stacked
