@@ -134,6 +134,17 @@ def main() -> None:
     for row in bench_halo.run_coalescing_ab(dims3, cpu):
         results.append(bench_util.emit(row))
 
+    # --- quantized halo wire A/B (ISSUE 10) --------------------------------
+    # static f32/int8 wire-byte ratio at 4 coalesced fields (payload +
+    # per-slab scales), the quantize/dequantize overhead gate on the live
+    # mesh, and the modeled exposed-comm delta of the per-axis z:int8
+    # policy on an ICI+DCN profile. Config owned by
+    # `bench_quant.run_quant_ab` (shared with the standalone bench).
+    import bench_quant
+
+    for row in bench_quant.run_quant_ab(dims3, cpu):
+        results.append(bench_util.emit(row))
+
     # --- resilience guard overhead (guarded vs plain chunk) ----------------
     # the supervised driver's per-chunk health probe + fetch as a fraction
     # of step time; target < 2% (ISSUE 2). Config owned by

@@ -40,6 +40,11 @@ def main() -> None:
 
     nx = int(sys.argv[1]) if len(sys.argv) > 1 else 48
     nt = int(sys.argv[2]) if len(sys.argv) > 2 else 400
+    # the reference legs (f64 ground truth, f32, bf16) must run with an
+    # EXACT wire even if the invoking shell exports IGG_HALO_WIRE_DTYPE
+    # — an ambient policy would silently corrupt every drift row; the
+    # wire legs set it per leg below
+    os.environ.pop("IGG_HALO_WIRE_DTYPE", None)
     nd = len(jax.devices())
     dims = tuple(int(d) for d in igg.dims_create(nd, (0, 0, 0)))
 
@@ -49,18 +54,23 @@ def main() -> None:
     # compute — `pallas_stencil._stencil_plane`'s mixed-precision recipe).
     # "f64_bf16ic" integrates the bf16-QUANTIZED initial condition in f64:
     # bf16 legs compared against it isolate ARITHMETIC error from the
-    # (irreducible) IC quantization error.
-    legs = ((np.float64, "f64", None, False),
-            (np.float32, "f32", None, False),
-            (np.float64, "f64_bf16ic", None, True),
-            (jnp.bfloat16, "bf16_xla", "xla", False),
-            (jnp.bfloat16, "bf16_kernel", "pallas_interpret", False),
+    # (irreducible) IC quantization error. The wire legs (5th tuple slot)
+    # run f32 state with the quantized halo wire (ISSUE 10): drift vs f64
+    # is the accuracy cost of shipping halos as per-slab-scaled int8/int4
+    # — the error model docs/performance.md tabulates.
+    legs = ((np.float64, "f64", None, False, None),
+            (np.float32, "f32", None, False, None),
+            (np.float64, "f64_bf16ic", None, True, None),
+            (jnp.bfloat16, "bf16_xla", "xla", False, None),
+            (jnp.bfloat16, "bf16_kernel", "pallas_interpret", False, None),
             # stochastic-rounding bf16 storage (ops/precision.py): f32
             # compute, unbiased bf16 store — the leg that decides whether
             # bf16 is a correctness-preserving mode or only a bandwidth
             # study (round-4 verdict)
-            (jnp.bfloat16, "bf16_sr", "sr", False))
-    for dtype, tag, impl, bf16_ic in legs:
+            (jnp.bfloat16, "bf16_sr", "sr", False, None),
+            (np.float32, "int8_wire", None, False, "int8"),
+            (np.float32, "int4_wire", None, False, "int4"))
+    for dtype, tag, impl, bf16_ic, wire in legs:
         igg.init_global_grid(nx, nx, nx, dimx=dims[0], dimy=dims[1],
                              dimz=dims[2], periodx=1, periody=1, periodz=1,
                              quiet=True)
@@ -73,8 +83,14 @@ def main() -> None:
             Cp = igg.device_put_g(np.asarray(Cpb).astype(dtype))
         else:
             T, Cp, p = init_diffusion3d(dtype=dtype, sr=(impl == "sr"))
-        out = run_diffusion(T, Cp, p, nt, nt_chunk=max(1, nt // 4),
-                            impl=None if impl == "sr" else impl)
+        if wire is not None:
+            os.environ["IGG_HALO_WIRE_DTYPE"] = wire
+        try:
+            out = run_diffusion(T, Cp, p, nt, nt_chunk=max(1, nt // 4),
+                                impl=None if impl == "sr" else impl)
+        finally:
+            if wire is not None:
+                os.environ.pop("IGG_HALO_WIRE_DTYPE", None)
         finals[tag] = np.asarray(igg.gather_interior(out), dtype=np.float64)
         igg.finalize_global_grid()
 
@@ -84,7 +100,8 @@ def main() -> None:
     for tag, ref_tag in (("f32", "f64"), ("f64_bf16ic", "f64"),
                          ("bf16_xla", "f64_bf16ic"),
                          ("bf16_kernel", "f64_bf16ic"),
-                         ("bf16_sr", "f64_bf16ic")):
+                         ("bf16_sr", "f64_bf16ic"),
+                         ("int8_wire", "f64"), ("int4_wire", "f64")):
         d = finals[tag] - finals[ref_tag]
         drift[tag] = {
             "vs": ref_tag,
@@ -106,7 +123,10 @@ def main() -> None:
                 "quantization; bf16_xla / bf16_kernel compare against it, "
                 "isolating ARITHMETIC drift: native bf16 flux arithmetic "
                 "vs the kernel tier's bf16-storage/f32-compute recipe vs "
-                "stochastic-rounding storage (bf16_sr, ops/precision.py)",
+                "stochastic-rounding storage (bf16_sr, ops/precision.py). "
+                "int8_wire / int4_wire (vs f64) run f32 state with the "
+                "quantized halo wire (ISSUE 10, per-slab-scaled payloads): "
+                "the drift bound the quant-marked accuracy tier asserts",
     }))
 
 

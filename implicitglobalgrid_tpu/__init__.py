@@ -53,7 +53,6 @@ from .tools import (
 from .utils.timing import tic, toc, barrier, sync
 from .utils.profiling import (
     trace, annotate, overlap_stats, op_breakdown,
-    health_counters, record_health_event, reset_health_counters,
 )
 from .utils.checkpoint import (
     save_checkpoint, restore_checkpoint, load_checkpoint,
@@ -119,7 +118,6 @@ __all__ = [
     # multi-run scheduler (the mesh as a persistent simulation service)
     "service", "MeshScheduler", "JobSpec", "JobState", "service_report",
     "export_service_trace",
-    "health_counters", "record_health_event", "reset_health_counters",
     # telemetry (metrics registry, flight recorder, exporters, run report)
     "MetricsRegistry", "metrics_registry", "reset_metrics",
     "prometheus_snapshot", "FlightRecorder", "start_flight_recorder",

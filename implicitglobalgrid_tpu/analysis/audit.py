@@ -195,11 +195,21 @@ def audit_model(model: str, *, impl: str = "xla", dtype=None,
     saved_wire = os.environ.get("IGG_HALO_WIRE_DTYPE")
     try:
         if wire_dtype is not None:
-            os.environ["IGG_HALO_WIRE_DTYPE"] = str(wire_dtype)
+            from ..ops.precision import resolve_wire_dtype
+
+            policy = resolve_wire_dtype(wire_dtype)
+            # the CANONICAL policy string: every accepted form (dict,
+            # WirePolicy, dtype-like) round-trips through the env var the
+            # runners resolve at trace time — str() of a dict would not
+            os.environ["IGG_HALO_WIRE_DTYPE"] = str(policy)
             if optimized:
                 import jax
-
-                if jax.devices()[0].platform == "cpu":
+                # only narrow FLOAT casts are at the mercy of the CPU
+                # backend's float-normalization pass; quantized int8
+                # payloads survive optimized HLO on every backend, so a
+                # quant-only policy keeps the deeper post-SPMD audit
+                if policy is not None and policy.casts_any_below \
+                        and jax.devices()[0].platform == "cpu":
                     optimized = False
                     meta["lowered_for_wire_audit"] = (
                         "XLA:CPU normalizes narrow wire payloads back to "

@@ -223,6 +223,8 @@ _NP_TO_HLO = {
     "int8": "s8", "int16": "s16", "int32": "s32", "int64": "s64",
     "uint8": "u8", "uint16": "u16", "uint32": "u32", "uint64": "u64",
     "complex64": "c64", "complex128": "c128",
+    # quantized wire payloads (bit-packed int4 included) ship as s8 bytes
+    "int4": "s8",
 }
 
 

@@ -10,11 +10,16 @@ this framework must never contain:
   partitioning failure (optimized-HLO dialect only: the pre-partitioning
   StableHLO module legitimately carries stacked arrays at the jit
   boundary).
-- ``wire-downcast-missing`` — a reduced-precision wire dtype was
-  requested but no permute payload carries it (the narrowing silently
-  didn't happen). Meaningful on the LOWERED module for CPU runs — the
-  XLA:CPU float-normalization pass rewrites bf16 payloads back to f32 in
-  backend-optimized text; TPU keeps them native.
+- ``wire-downcast-missing`` — a reduced-precision wire format was
+  requested but some float permute payload still crosses the link wider
+  than its axis allows (the narrowing silently didn't happen). Per-axis
+  aware: under ``"z:int8,x:f32"`` a full-width payload on the exact
+  x-axis is legal while a stale f32 payload on the quantized z-axis
+  flags; integer (quantized s8) payloads are never stale. Float casts
+  are meaningful on the LOWERED module for CPU runs — the XLA:CPU
+  float-normalization pass rewrites bf16 payloads back to f32 in
+  backend-optimized text; TPU keeps them native (quantized int8 payloads
+  survive both dialects).
 - ``donation-unaliased`` — fewer input-output aliases in the module
   header than donated inputs: each missing alias is a hidden full-block
   copy per dispatch.
@@ -66,26 +71,43 @@ class LintConfig:
     `default_lint_config`); ``state_dtypes`` are the dtypes the program's
     state legitimately holds (f64 presence beyond these flags);
     ``wire_dtype`` is the REQUESTED reduced-precision wire format (HLO
-    spelling, e.g. ``"bf16"``) whose absence from the wire should flag;
-    ``expect_donation`` is the number of donated inputs that must appear
-    as input-output aliases."""
+    spelling, e.g. ``"bf16"``) whose absence from the wire should flag.
+    Under a PER-AXIS wire policy, ``wire_axes`` maps mesh axis names to
+    the HLO spelling of that axis's on-wire dtype (axes missing from the
+    map are exact — any payload width legal there) and ``routes`` (the
+    `contracts.axis_routes` table) attributes each permute to its axis,
+    so an exact-by-policy axis's full-width payload no longer false-flags.
+    With ``wire_axes`` set, a permute that cannot be attributed (missing
+    routes, unknown pair set) is never flagged and ``wire_dtype`` is NOT
+    consulted — it only feeds the finding message (see
+    `_allowed_wire_width`). ``expect_donation`` is the number of donated
+    inputs that must appear as input-output aliases."""
 
     global_shape: tuple | None = None
     local_shape: tuple | None = None
     state_dtypes: tuple = ()
     wire_dtype: str | None = None
+    wire_axes: dict | None = None
+    routes: dict | None = None
     expect_donation: int | None = None
 
 
 _WIRE_NAMES = {"bfloat16": "bf16", "float16": "f16", "float32": "f32",
-               "float64": "f64"}
+               "float64": "f64",
+               # quantized payloads (incl. bit-packed int4) ship as s8
+               "int8": "s8", "int4": "s8"}
 
 
 def default_lint_config(grid=None, *, state_dtypes=(), wire_dtype=None,
                         expect_donation=None) -> LintConfig:
     """Build a config from the LIVE grid: the forbidden global shape is
     ``nxyz_g``, the legitimate block shape ``nxyz``. ``wire_dtype``
-    accepts numpy/jax spellings (``bfloat16``) or HLO ones (``bf16``)."""
+    accepts numpy/jax spellings (``bfloat16``), HLO ones (``bf16``),
+    quantized formats (``int8``/``int4``), a per-axis policy spec
+    (``"z:int8,x:f32"``), or a resolved `ops.precision.WirePolicy` — a
+    per-axis policy additionally fills ``wire_axes``/``routes`` from the
+    live grid so the wire-downcast lint judges each permute against ITS
+    axis's width."""
     from ..parallel.topology import global_grid, grid_is_initialized
 
     gshape = lshape = None
@@ -93,15 +115,68 @@ def default_lint_config(grid=None, *, state_dtypes=(), wire_dtype=None,
         gg = grid if grid is not None else global_grid()
         gshape = tuple(int(n) for n in gg.nxyz_g)
         lshape = tuple(int(n) for n in gg.nxyz)
-    wd = None
+    wd, wire_axes, routes = None, None, None
     if wire_dtype is not None:
-        wd = str(wire_dtype)
-        wd = _WIRE_NAMES.get(wd, wd)
+        policy = _maybe_policy(wire_dtype)
+        if policy is not None and policy.uniform is None:
+            # per-axis policy: widths judged per attributed axis only
+            # (unattributable permutes are never flagged — see
+            # `_allowed_wire_width`); `wire_dtype` records the WIDEST
+            # requested format purely for display in messages
+            from ..parallel.topology import AXIS_NAMES
+
+            wire_axes = {}
+            widest = None
+            for d, axis in enumerate(AXIS_NAMES):
+                fmt = policy.for_dim(d)
+                if fmt is None:
+                    continue
+                name = _WIRE_NAMES.get(str(fmt), str(fmt))
+                wire_axes[axis] = name
+                w = Shape(name, ()).itemsize
+                if widest is None or w > widest[0]:
+                    widest = (w, name)
+            wd = widest[1] if widest else None
+            if grid is not None or grid_is_initialized():
+                from .contracts import axis_routes
+
+                routes = axis_routes(grid if grid is not None
+                                     else global_grid())
+        elif policy is not None:
+            wd = _WIRE_NAMES.get(str(policy.uniform), str(policy.uniform))
+        else:
+            wd = str(wire_dtype)
+            wd = _WIRE_NAMES.get(wd, wd)
     return LintConfig(
         global_shape=gshape, local_shape=lshape,
         state_dtypes=tuple(_WIRE_NAMES.get(str(d), str(d))
                            for d in state_dtypes),
-        wire_dtype=wd, expect_donation=expect_donation)
+        wire_dtype=wd, wire_axes=wire_axes, routes=routes,
+        expect_donation=expect_donation)
+
+
+def _maybe_policy(wire_dtype):
+    """`ops.precision.WirePolicy` for the argument when it parses as one.
+    Callers also pass raw HLO spellings the policy parser doesn't know
+    (``"f64"``) — ONLY those recognized dtype spellings keep the legacy
+    string path; anything else that fails to parse (a typo'd axis
+    ``"w:int8"``, a bad format ``"int3"``) re-raises, because silently
+    falling through would hand `_lint_wire_downcast` a width-4 fallback
+    that never flags anything — a disabled lint disguised as a clean
+    audit."""
+    from ..ops.precision import WirePolicy, resolve_wire_dtype
+
+    if isinstance(wire_dtype, WirePolicy):
+        return wire_dtype
+    try:
+        return resolve_wire_dtype(wire_dtype)
+    except InvalidArgumentError:
+        from .hlo import _ITEMSIZE
+
+        s = str(wire_dtype)
+        if s in _WIRE_NAMES or s in _ITEMSIZE:
+            return None
+        raise
 
 
 # ---------------------------------------------------------------------------
@@ -126,37 +201,78 @@ def _lint_global_materialization(ir: ProgramIR, cfg: LintConfig) -> list:
     return out
 
 
-def _lint_wire_downcast(ir: ProgramIR, cfg: LintConfig) -> list:
+def _is_float_payload(pay) -> bool:
+    return pay is not None and pay.dtype.lstrip("b").startswith("f") \
+        and not pay.dtype.startswith("f8")
+
+
+def _allowed_wire_width(cfg: LintConfig, op) -> int | None:
+    """The widest payload the policy allows for this permute: its
+    attributed axis's configured width under a per-axis policy (an axis
+    the policy leaves exact allows any width -> None), else the global
+    wire width. Under a PER-AXIS policy a permute that cannot be
+    attributed (no routes table — e.g. a host-only dump audit — or a
+    pair set matching no axis) is NEVER flagged: without attribution a
+    full-width payload may legally belong to an exact-by-policy axis,
+    and unplanned routes are the contract checker's `permute-route`
+    finding, not this lint's. Integer payloads never reach this (the
+    quantized s8 buffer IS the narrowing — only float payloads can be
+    stale)."""
+    # truthiness, matching `_lint_wire_downcast`'s guard: an EMPTY
+    # wire_axes map must fall through to the global width, not disable
+    # the lint
+    if cfg.wire_axes:
+        if cfg.routes is not None:
+            from .contracts import attribute_axis
+
+            pairs = op.attrs.get("source_target_pairs") or ()
+            axis = attribute_axis(cfg.routes, pairs) if pairs else None
+            if axis is not None:
+                name = cfg.wire_axes.get(axis)
+                return None if name is None else Shape(name, ()).itemsize
+        return None
     if cfg.wire_dtype is None:
+        return None
+    return Shape(cfg.wire_dtype, ()).itemsize
+
+
+def _lint_wire_downcast(ir: ProgramIR, cfg: LintConfig) -> list:
+    if cfg.wire_dtype is None and not cfg.wire_axes:
         return []
     permutes = ir.permutes
     if not permutes:
         return []
-    # EVERY float payload must be at or below the wire width — a partial
-    # regression (one axis narrowed, the others still full precision) is
-    # as real a bandwidth loss as a total one. Width, not equality: an
-    # f16 field under bf16 wire legitimately ships as f16
-    # (`wire_dtype_for` never widens a payload).
-    wire_width = Shape(cfg.wire_dtype, ()).itemsize
-    stale = [p for p in permutes
-             if (pay := ir.payload_of(p)) is not None
-             and pay.dtype.lstrip("b").startswith("f")
-             and not pay.dtype.startswith("f8")
-             and pay.itemsize > wire_width]
+    # EVERY float payload must be at or below ITS AXIS's wire width — a
+    # partial regression (one axis narrowed, the others still full
+    # precision) is as real a bandwidth loss as a total one, while a
+    # full-width payload on an axis the per-axis policy leaves exact is
+    # legal (the pre-policy global check flagged those). Width, not
+    # equality: an f16 field under bf16 wire legitimately ships as f16,
+    # and integer (quantized) payloads are always at or below any
+    # configured width (`wire_format_for` never widens a payload).
+    stale = []
+    for p in permutes:
+        pay = ir.payload_of(p)
+        if not _is_float_payload(pay):
+            continue
+        allowed = _allowed_wire_width(cfg, p)
+        if allowed is not None and pay.itemsize > allowed:
+            stale.append(p)
     if not stale:
         return []
-    n_float = sum(1 for p in permutes
-                  if (pay := ir.payload_of(p)) is not None
-                  and pay.dtype.lstrip("b").startswith("f"))
+    n_float = sum(1 for p in permutes if _is_float_payload(ir.payload_of(p)))
     got = sorted({str(ir.payload_of(p)) for p in stale})
+    wire_desc = (",".join(f"{a}:{d}" for a, d in sorted(cfg.wire_axes.items()))
+                 if cfg.wire_axes else cfg.wire_dtype)
     return [AuditFinding(
         "wire-downcast-missing", SEV_ERROR,
-        f"wire dtype {cfg.wire_dtype!r} requested but {len(stale)} of "
+        f"wire dtype {wire_desc!r} requested but {len(stale)} of "
         f"{n_float} float collective-permute payload(s) still cross the "
-        f"link wider than it (stale payloads: {got}) — the narrowing "
-        "did not reach (all of) the wire. (Audit the LOWERED module on "
-        "CPU: its backend normalizes bf16 payloads back to f32.)",
-        details={"wire_dtype": cfg.wire_dtype, "payloads": got,
+        f"link wider than it allows (stale payloads: {got}) — the "
+        "narrowing did not reach (all of) the wire. (Audit the LOWERED "
+        "module on CPU: its backend normalizes bf16 payloads back to "
+        "f32.)",
+        details={"wire_dtype": wire_desc, "payloads": got,
                  "stale": len(stale), "float_permutes": n_float})]
 
 

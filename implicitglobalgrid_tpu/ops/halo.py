@@ -48,13 +48,20 @@ self-neighbor axes have no collective to coalesce and keep their local
 copies. Results are bit-identical to the per-field path
 (tests/test_update_halo.py) — packing is ravel/concat, no arithmetic.
 
-Wire precision (default OFF; `IGG_HALO_WIRE_DTYPE` / ``wire_dtype=``): f32/f64
-state optionally crosses the ICI link as a narrower float
-(convert → pack → ppermute → unpack → convert back, the EQuARX play,
-arXiv:2506.17615) — ~2x less wire traffic on bandwidth-bound exchanges, at
-reduced halo precision. Applies to every ppermute payload (coalesced or
-per-field); PROC_NULL boundary halos and self-neighbor local copies never
-round-trip through the wire dtype. See `ops.precision.wire_dtype_for`.
+Wire precision (default OFF; `IGG_HALO_WIRE_DTYPE` / ``wire_dtype=``): float
+state optionally crosses the link narrowed (the EQuARX play,
+arXiv:2506.17615) — either as a narrower float CAST
+(convert → pack → ppermute → unpack → convert back, ~2x) or QUANTIZED as
+symmetric per-slab-scaled ``int8`` / bit-packed ``int4`` (quantize each
+field's send slab against its own max-abs scale, append the f32 scales to
+the coalesced flat buffer, ppermute ONE int8 payload per direction,
+dequantize on unpack — ~3.5-7.5x less wire traffic). The policy is PER
+MESH AXIS (``wire_dtype="z:int8,x:f32"``): a slow DCN-mapped axis can
+quantize while ICI axes stay exact (HiCCL, arXiv:2408.05962). Applies to
+every ppermute payload (coalesced or per-field; quantized fields always
+ride the packed layout, whose flat buffer carries the scales); PROC_NULL
+boundary halos and self-neighbor local copies never round-trip through
+the wire format. See `ops.precision.wire_format_for`.
 """
 
 from __future__ import annotations
@@ -69,7 +76,10 @@ from ..utils.exceptions import IncoherentArgumentError, InvalidArgumentError
 from .fields import (
     Field, check_fields, extract, field_partition_spec, wrap_field,
 )
-from .precision import resolve_wire_dtype, wire_dtype_for
+from .precision import (
+    SCALE_BYTES, decode_scales, dequantize_slab, encode_scales,
+    quant_slab_bytes, quantize_slab, resolve_wire_dtype, wire_format_for,
+)
 
 __all__ = ["update_halo", "local_update_halo", "free_update_halo_caches",
            "halo_may_use_pallas", "resolve_halo_coalesce", "halo_comm_plan",
@@ -370,12 +380,18 @@ def _check_slab_fit(s, dim, ol_d, hw):
         )
 
 
-def _coalesce_groups(gg, arrays, hws, handled, dims_order):
+def _coalesce_groups(gg, arrays, hws, handled, dims_order, coalesce=True,
+                     wire=None):
     """Packing plan for the coalesced exchange: ``{dim: [group, ...]}``
-    where each group is a tuple of >= 2 field indices of ONE dtype that all
-    exchange along ppermute axis ``dim`` (a lone field per dtype gains
-    nothing from packing and keeps the per-field path — the fallback the
-    packer declares by simply not grouping)."""
+    where each group is a tuple of field indices of ONE dtype that all
+    exchange along ppermute axis ``dim``. Without wire quantization a
+    group needs >= 2 fields (a lone field per dtype gains nothing from
+    packing and keeps the per-field path — the fallback the packer
+    declares by simply not grouping). A dtype the policy QUANTIZES along
+    ``dim`` always rides the packed path — its payload carries the
+    appended per-slab scales, a layout only the flat buffer has — even as
+    a singleton, and with ``coalesce=False`` each quantized field packs
+    its own buffer (per-field collective count preserved)."""
     out = {}
     for dim in dims_order:
         D, periodic, disp = _dim_meta(gg, dim)
@@ -387,7 +403,14 @@ def _coalesce_groups(gg, arrays, hws, handled, dims_order):
                 continue
             if _dim_exchanges(gg, a.shape, hws[i], dim):
                 by_dt.setdefault(np.dtype(a.dtype), []).append(i)
-        groups = [tuple(g) for g in by_dt.values() if len(g) >= 2]
+        groups = []
+        for dt, idxs in by_dt.items():
+            fmt = wire_format_for(dt, wire, dim)
+            quant = fmt is not None and fmt.is_quant
+            if quant and not coalesce:
+                groups.extend((i,) for i in idxs)
+            elif quant or (coalesce and len(idxs) >= 2):
+                groups.append(tuple(idxs))
         if groups:
             out[dim] = groups
     return out
@@ -405,13 +428,51 @@ def _coalesced_pallas_mode(gg, dim, shapes, hws_dim):
     return bool(gg.use_pallas[dim]) and gg.device_type == "tpu", False
 
 
+def _quant_pack_group(parts, fmt):
+    """Quantize each field's raveled send slab against its own max-abs
+    scale and pack ONE int8 wire buffer: ``q_0 | q_1 | ... | scales``
+    (per-slab f32 scales bitcast to `SCALE_BYTES` int8 each, riding the
+    same buffer so the axis still costs a single ppermute pair)."""
+    import jax.numpy as jnp
+
+    qs, scales = zip(*(quantize_slab(p, fmt) for p in parts))
+    return jnp.concatenate(list(qs) + [encode_scales(list(scales))])
+
+
+def _quant_unpack_group(buf, sizes, fmt, out_dtype):
+    """Inverse of `_quant_pack_group`: split the received int8 buffer back
+    into per-field quantized slabs + the scale tail, dequantize each slab
+    with ITS OWN received scale, and return the state-dtype flat buffer
+    (``sum(sizes)`` cells) the existing unpack pipeline consumes."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    qsizes = [quant_slab_bytes(n, fmt) for n in sizes]
+    data = sum(qsizes)
+    scales = decode_scales(
+        lax.slice_in_dim(buf, data, data + SCALE_BYTES * len(sizes), axis=0),
+        len(sizes))
+    parts, off = [], 0
+    for k, (n, qb) in enumerate(zip(sizes, qsizes)):
+        parts.append(dequantize_slab(
+            lax.slice_in_dim(buf, off, off + qb, axis=0), scales[k], n,
+            fmt, out_dtype))
+        off += qb
+    return jnp.concatenate(parts)
+
+
 def _exchange_dim_coalesced(gg, arrays, idxs, hws, dim, wire=None):
     """Exchange the halos of fields ``idxs`` (one dtype) along ``dim`` with
     ONE ppermute pair: ravel + concatenate every field's send slab into a
     flat buffer per direction, permute, split/reshape, deliver. Mutates
-    ``arrays``. Values are bit-identical to the per-field exchange — the
-    pack stage is pure layout (and the PROC_NULL boundary select runs on
-    the packed buffer, elementwise-equal to the per-field selects)."""
+    ``arrays``. With exact wire, values are bit-identical to the per-field
+    exchange — the pack stage is pure layout (and the PROC_NULL boundary
+    select runs on the packed buffer, elementwise-equal to the per-field
+    selects). Under a cast wire format the buffer crosses the link
+    narrowed; under a QUANT format (int8/int4) each field's slab is
+    quantized against its own max-abs scale and the f32 scales ride the
+    same buffer (`_quant_pack_group`) — still one ppermute pair, wire
+    bytes ~4-8x down."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -436,16 +497,24 @@ def _exchange_dim_coalesced(gg, arrays, idxs, hws, dim, wire=None):
             cur_l_parts.append(lax.slice_in_dim(a, 0, hw, axis=dim).reshape(-1))
             cur_r_parts.append(lax.slice_in_dim(a, s - hw, s, axis=dim).reshape(-1))
 
-    flat_r = jnp.concatenate(parts_r)
-    flat_l = jnp.concatenate(parts_l)
-    wire_dt = wire_dtype_for(flat_r.dtype, wire)
-    state_dt = flat_r.dtype
-    if wire_dt is not None:
-        flat_r = flat_r.astype(wire_dt)
-        flat_l = flat_l.astype(wire_dt)
+    state_dt = arrays[idxs[0]].dtype
+    fmt = wire_format_for(state_dt, wire, dim)
+    sizes = [m[4] for m in metas]
+    if fmt is not None and fmt.is_quant:
+        flat_r = _quant_pack_group(parts_r, fmt)
+        flat_l = _quant_pack_group(parts_l, fmt)
+    else:
+        flat_r = jnp.concatenate(parts_r)
+        flat_l = jnp.concatenate(parts_l)
+        if fmt is not None:
+            flat_r = flat_r.astype(fmt.dtype)
+            flat_l = flat_l.astype(fmt.dtype)
     recv_l = lax.ppermute(flat_r, axis_name, perm_p)
     recv_r = lax.ppermute(flat_l, axis_name, perm_m)
-    if wire_dt is not None:
+    if fmt is not None and fmt.is_quant:
+        recv_l = _quant_unpack_group(recv_l, sizes, fmt, state_dt)
+        recv_r = _quant_unpack_group(recv_r, sizes, fmt, state_dt)
+    elif fmt is not None:
         recv_l = recv_l.astype(state_dt)
         recv_r = recv_r.astype(state_dt)
     if not periodic:
@@ -493,22 +562,35 @@ def _exchange_arrays(gg, arrays, hws, dims_order, coalesce=None, wire=None):
     dtype groups) > combined one-pass unpack > per-dim per-field.
 
     ``coalesce=None`` resolves `resolve_halo_coalesce` (env default ON);
-    ``wire`` is the RESOLVED wire dtype (`precision.resolve_wire_dtype`)
+    ``wire`` is the RESOLVED wire policy (`precision.resolve_wire_dtype`)
     or None for full-precision wire. Wire mode routes its fields through
     the coalesced/per-dim paths (the combined one-pass tier has its own
-    full-precision permutes)."""
+    full-precision permutes); quantized formats always ride the packed
+    path (the scales live in the flat buffer — `_coalesce_groups`)."""
     if coalesce is None:
         coalesce = resolve_halo_coalesce(None)
     handled = _apply_self_exchange(gg, arrays, hws, dims_order)
-    groups_by_dim = _coalesce_groups(gg, arrays, hws, handled, dims_order) \
-        if coalesce else {}
+    groups_by_dim = _coalesce_groups(gg, arrays, hws, handled, dims_order,
+                                     coalesce=coalesce, wire=wire)
     grouped = {i for gs in groups_by_dim.values() for g in gs for i in g}
+    def wire_touches(a, hw):
+        # whether the policy can actually reach one of THIS field's
+        # ppermute payloads: a policy-named dim that is unpartitioned
+        # (D==1: self-copies stay exact) or that the field does not
+        # exchange along is a no-op for it
+        return any(
+            wire_format_for(a.dtype, wire, d) is not None
+            and _dim_meta(gg, d)[0] > 1
+            and _dim_exchanges(gg, a.shape, hw, d)
+            for d in dims_order)
+
     for i, a in enumerate(arrays):
         # wire-affected fields skip the combined tier (its permutes are
-        # full-precision); fields the wire dtype can never touch (ints,
-        # already-narrow floats) keep it.
-        if handled[i] or i in grouped \
-                or wire_dtype_for(a.dtype, wire) is not None:
+        # full-precision); fields the wire policy can never touch (ints,
+        # already-narrow floats, fields whose policy-named dims carry no
+        # ppermute for them) keep the faster one-pass kernel — evicting
+        # those would pay per-dim exchanges for bit-identical results.
+        if handled[i] or i in grouped or wire_touches(a, hws[i]):
             continue
         modes = _combined_plan(gg, a.shape, hws[i], dims_order)
         if modes is not None:
@@ -547,9 +629,11 @@ def _exchange_dim_local(a, *, dim, hw, ol_d, D, periodic, disp, axis_name,
     coordinate (`axis_index`) is traced. With ``pallas_write``, the unpack
     writes the halo slabs in place via the Pallas kernels (`pallas_halo.py`)
     instead of full-array `dynamic_update_slice` rewrites. ``wire`` is the
-    resolved wire-precision dtype: ppermute payloads cross the link
-    narrowed (`precision.wire_dtype_for`); local self-neighbor copies and
-    PROC_NULL boundary halos never do.
+    resolved wire policy: CAST formats narrow the ppermute payloads here
+    (`precision.wire_format_for`); QUANT formats never reach this path —
+    `_coalesce_groups` routes every quantized field through the packed
+    exchange, whose flat buffer carries the per-slab scales. Local
+    self-neighbor copies and PROC_NULL boundary halos stay exact.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -584,7 +668,8 @@ def _exchange_dim_local(a, *, dim, hw, ol_d, D, periodic, disp, axis_name,
     if not perm_p and not perm_m:
         return a
 
-    wire_dt = wire_dtype_for(a.dtype, wire)
+    fmt = wire_format_for(a.dtype, wire, dim)
+    wire_dt = None if fmt is None or fmt.is_quant else fmt.dtype
     if wire_dt is not None:
         send_r = send_r.astype(wire_dt)
         send_l = send_l.astype(wire_dt)
@@ -620,8 +705,10 @@ def local_update_halo(*fields, dims=None, coalesce=None, wire_dtype=None):
     0-based dimension processing order (default z, x, y like the reference's
     `(3,1,2)`). ``coalesce`` packs multi-field exchanges into one ppermute
     pair per (axis, dtype group) — default from ``IGG_HALO_COALESCE`` (ON);
-    ``wire_dtype`` ships float payloads across the link narrowed — default
-    from ``IGG_HALO_WIRE_DTYPE`` (OFF); see the module docstring.
+    ``wire_dtype`` ships float payloads across the link narrowed (float
+    casts) or per-slab-scale quantized (``int8``/``int4``), optionally per
+    mesh axis (``"z:int8,x:f32"``) — default from ``IGG_HALO_WIRE_DTYPE``
+    (OFF); see the module docstring.
 
     NOTE: on a default TPU grid this emits Pallas kernels (in-place halo
     writes / single-pass self-exchange), which cannot pass `shard_map`'s
@@ -702,8 +789,11 @@ def _plan_from_sig(gg, sig, dims_order, coalesce, wire) -> dict:
     combined one-pass, plain `dynamic_update_slice` all consume the SAME
     permuted slabs), so the plan only branches on what actually changes
     the wire: coalescing (one packed ppermute pair per (axis, dtype
-    group) instead of one pair per field) and the wire dtype (narrowed
-    payloads). ``wire_bytes`` sums the payload over every source->dest
+    group) instead of one pair per field) and the wire policy (narrowed
+    or quantized payloads — a quantized group's bytes count the int8/
+    packed-int4 slabs PLUS the `SCALE_BYTES` f32 scale per slab, exactly
+    the buffer `_quant_pack_group` ships, so the plan stays exact to the
+    byte). ``wire_bytes`` sums the payload over every source->dest
     link of the permute (all shards), both directions;
     ``local_copy_bytes`` counts self-neighbor slab swaps that never touch
     the interconnect."""
@@ -721,18 +811,17 @@ def _plan_from_sig(gg, sig, dims_order, coalesce, wire) -> dict:
             AXIS_NAMES[dim], {"ppermutes": 0, "wire_bytes": 0,
                               "by_dtype": {}})
 
-    def add_wire(dim, cells, dtype, npairs):
+    def add_wire(dim, payload_bytes, key, npairs):
         rec = axis_rec(dim)
         rec["ppermutes"] += 2
-        b = cells * np.dtype(dtype).itemsize * npairs
+        b = payload_bytes * npairs
         rec["wire_bytes"] += b
-        key = str(np.dtype(dtype))
         rec["by_dtype"][key] = rec["by_dtype"].get(key, 0) + b
 
     local_bytes = 0
     groups_by_dim = _coalesce_groups(
-        gg, fields, hws, [False] * len(fields), dims_order) \
-        if coalesce else {}
+        gg, fields, hws, [False] * len(fields), dims_order,
+        coalesce=coalesce, wire=wire)
     for dim in dims_order:
         D, periodic, disp = _dim_meta(gg, dim)
         if D == 1 and not periodic:
@@ -743,20 +832,28 @@ def _plan_from_sig(gg, sig, dims_order, coalesce, wire) -> dict:
         for g in groups_by_dim.get(dim, ()):  # groups only form on D>1 axes
             in_group.update(g)
             f0 = fields[g[0]]
-            wd = wire_dtype_for(f0.dtype, wire) or f0.dtype
-            add_wire(dim, sum(slab_cells(i, dim) for i in g), wd, npairs)
+            fmt = wire_format_for(f0.dtype, wire, dim)
+            if fmt is not None and fmt.is_quant:
+                payload = sum(quant_slab_bytes(slab_cells(i, dim), fmt)
+                              for i in g) + SCALE_BYTES * len(g)
+                add_wire(dim, payload, fmt.name, npairs)
+            else:
+                wd = np.dtype(fmt.dtype if fmt is not None else f0.dtype)
+                payload = sum(slab_cells(i, dim) for i in g) * wd.itemsize
+                add_wire(dim, payload, str(wd), npairs)
         for i, f in enumerate(fields):
             if i in in_group or not _dim_exchanges(gg, f.shape, hws[i], dim):
                 continue
             if D == 1:  # periodic self-neighbor: local slab swap, no wire
                 local_bytes += 2 * slab_cells(i, dim) * f.dtype.itemsize
                 continue
-            wd = wire_dtype_for(f.dtype, wire) or f.dtype
-            add_wire(dim, slab_cells(i, dim), wd, npairs)
+            fmt = wire_format_for(f.dtype, wire, dim)
+            wd = np.dtype(fmt.dtype if fmt is not None else f.dtype)
+            add_wire(dim, slab_cells(i, dim) * wd.itemsize, str(wd), npairs)
     return {
         "fields": len(fields),
         "coalesce": bool(coalesce),
-        "wire_dtype": None if wire is None else str(np.dtype(wire)),
+        "wire_dtype": None if wire is None else str(wire),
         "axes": axes,
         "ppermutes": sum(r["ppermutes"] for r in axes.values()),
         "wire_bytes": sum(r["wire_bytes"] for r in axes.values()),
@@ -858,7 +955,9 @@ def update_halo(*fields, dims=None, coalesce=None, wire_dtype=None):
     ``IGG_HALO_COALESCE``: ON), the stronger form of the reference's
     multi-field pipelining note (`update_halo.jl:17-18`). ``wire_dtype``
     (default from ``IGG_HALO_WIRE_DTYPE``: OFF) ships float payloads across
-    the link at reduced precision; see the module docstring.
+    the link at reduced precision — float casts or per-slab-scaled
+    ``int8``/``int4`` quantization, per mesh axis (``"z:int8,x:f32"``);
+    see the module docstring.
 
     Example (doctest):
 

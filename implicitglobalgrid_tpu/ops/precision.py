@@ -22,72 +22,255 @@ surrounding stencil kernel.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+
 __all__ = ["stochastic_round_bf16", "shard_unique_fold",
-           "resolve_wire_dtype", "wire_dtype_for"]
+           "resolve_wire_dtype", "wire_dtype_for", "wire_format_for",
+           "WireFormat", "WirePolicy", "SCALE_BYTES",
+           "quantize_slab", "dequantize_slab", "encode_scales",
+           "decode_scales", "quant_slab_bytes"]
 
 
 # ---------------------------------------------------------------------------
 # Halo wire-precision mode (EQuARX-style reduced-precision collectives,
-# arXiv:2506.17615): f32/f64 state optionally crosses the ICI link as a
-# narrower float — convert → pack → ppermute → unpack → convert back
-# (`ops.halo`). OFF by default: the exchange stays bit-identical unless the
-# user opts in via `IGG_HALO_WIRE_DTYPE` or the `wire_dtype=` kwarg of
-# `update_halo`/`local_update_halo`.
+# arXiv:2506.17615): float state optionally crosses the link as a narrower
+# float (convert → pack → ppermute → unpack → convert back) or as a
+# symmetric per-slab-scaled integer (quantize → pack q + f32 scales into
+# ONE flat buffer → ppermute → dequantize — `ops.halo`). The policy is
+# PER MESH AXIS (``"z:int8,x:f32"``): a slow DCN-mapped axis can quantize
+# while ICI axes stay exact (the HiCCL per-link-aggressiveness idea,
+# arXiv:2408.05962). OFF by default: the exchange stays bit-identical
+# unless the user opts in via `IGG_HALO_WIRE_DTYPE` or the `wire_dtype=`
+# kwarg of `update_halo`/`local_update_halo`.
 # ---------------------------------------------------------------------------
 
 _WIRE_OFF = (None, "", "0", "off", "none")
 
+# bytes of the f32 per-slab scale appended (bitcast to the payload's int8)
+# to each quantized field slab on the wire
+SCALE_BYTES = 4
+
+# symmetric quantization levels: q in [-L, L]
+_QUANT_LEVELS = {"int8": 127, "int4": 7}
+
+
+@dataclass(frozen=True)
+class WireFormat:
+    """One on-wire format: a float cast (``bfloat16``/``float16``/
+    ``float32``) or a symmetric per-slab-scaled integer quantization
+    (``int8``, bit-packed ``int4``). ``name`` is canonical."""
+
+    name: str
+
+    @property
+    def is_quant(self) -> bool:
+        return self.name in _QUANT_LEVELS
+
+    @property
+    def levels(self) -> int:
+        """Quantization levels L (q in [-L, L]); quant formats only."""
+        return _QUANT_LEVELS[self.name]
+
+    @property
+    def dtype(self):
+        """The numpy dtype elements of this format occupy on the wire
+        (quantized payloads — including bit-packed int4 — ship as int8
+        bytes)."""
+        import numpy as np
+
+        if self.is_quant:
+            return np.dtype(np.int8)
+        import jax.numpy as jnp
+
+        named = {"bfloat16": jnp.bfloat16, "float16": np.float16,
+                 "float32": np.float32}
+        return np.dtype(named[self.name])
+
+    def __str__(self) -> str:
+        return self.name
+
+    def __repr__(self) -> str:
+        return f"WireFormat({self.name!r})"
+
+
+# canonical names for every accepted wire-format spelling
+_FORMAT_NAMES = {
+    "bfloat16": "bfloat16", "bf16": "bfloat16",
+    "float16": "float16", "f16": "float16", "fp16": "float16",
+    "float32": "float32", "f32": "float32",
+    "int8": "int8", "s8": "int8", "i8": "int8",
+    "int4": "int4", "s4": "int4", "i4": "int4",
+}
+# per-axis spec tokens -> grid dimension index (accepts the short spatial
+# names of the ISSUE syntax and the mesh axis names gx/gy/gz)
+_AXIS_TOKENS = {"x": 0, "y": 1, "z": 2, "gx": 0, "gy": 1, "gz": 2}
+_DIM_NAMES = ("x", "y", "z")
+
+
+def _parse_format(token):
+    """One format token -> WireFormat | None (for the 'off' spellings)."""
+    from ..utils.exceptions import InvalidArgumentError
+
+    if isinstance(token, WireFormat):
+        return token
+    if isinstance(token, str):
+        token = token.strip().lower()
+    if token in _WIRE_OFF:
+        return None
+    name = None
+    if isinstance(token, str):
+        name = _FORMAT_NAMES.get(token)
+    else:
+        import numpy as np
+
+        try:
+            dt = np.dtype(token)
+        except TypeError:
+            dt = None
+        if dt is not None:
+            name = _FORMAT_NAMES.get(dt.name)
+    if name is None:
+        raise InvalidArgumentError(
+            f"Unsupported halo wire format {token!r}; supported: bfloat16, "
+            "float16, float32, int8, int4 (or 'off').")
+    return WireFormat(name)
+
+
+@dataclass(frozen=True)
+class WirePolicy:
+    """Resolved per-mesh-axis wire policy: one `WireFormat` (or ``None``
+    = exact) per grid dimension (x, y, z). The canonical string form
+    round-trips through `resolve_wire_dtype` (``"int8"`` when uniform,
+    else e.g. ``"x:float32,z:int8"``)."""
+
+    per_dim: tuple
+
+    def for_dim(self, dim: int):
+        """The requested format along grid dimension ``dim`` (None=exact;
+        dims beyond the policy — e.g. 2-D fields' missing z — are exact)."""
+        if 0 <= int(dim) < len(self.per_dim):
+            return self.per_dim[int(dim)]
+        return None
+
+    @property
+    def uniform(self):
+        """The single format when every dim shares one, else ``None``."""
+        fmts = set(self.per_dim)
+        return self.per_dim[0] if len(fmts) == 1 else None
+
+    @property
+    def casts_any_below(self) -> bool:
+        """Whether any dim requests a narrow FLOAT cast (< 4 bytes) — the
+        formats a backend float-normalization pass can rewrite away
+        (`analysis.audit` audits the lowered module for those)."""
+        return any(f is not None and not f.is_quant
+                   and f.dtype.itemsize < 4 for f in self.per_dim)
+
+    def __str__(self) -> str:
+        u = self.uniform
+        if u is not None:
+            return str(u)
+        parts = [f"{_DIM_NAMES[d]}:{f}"
+                 for d, f in enumerate(self.per_dim) if f is not None]
+        return ",".join(parts) if parts else "off"
+
+    def __repr__(self) -> str:
+        return f"WirePolicy({self})"
+
+
+def _uniform_policy(fmt):
+    return None if fmt is None else WirePolicy((fmt,) * 3)
+
 
 def resolve_wire_dtype(wire_dtype=None):
-    """Resolve the requested halo wire dtype to a canonical numpy dtype, or
-    ``None`` for full-precision wire (the default).
+    """Resolve the requested halo wire mode to a `WirePolicy`, or ``None``
+    for full-precision wire (the default).
 
     ``wire_dtype=None`` consults ``IGG_HALO_WIRE_DTYPE``; an explicit
-    argument (incl. ``"off"``) wins over the environment. Accepted wire
-    formats: ``bfloat16``, ``float16``, ``float32`` (the narrowing target
-    per state dtype is decided by :func:`wire_dtype_for`)."""
+    argument (incl. ``"off"``) wins over the environment. Accepted forms:
+
+    - a single format — ``"bfloat16"``/``"float16"``/``"float32"`` (float
+      casts), ``"int8"``/``"int4"`` (per-slab-scaled quantization), or a
+      numpy/jax dtype — applied on every mesh axis;
+    - a per-axis spec ``"z:int8,x:f32"`` (axes ``x``/``y``/``z`` or
+      ``gx``/``gy``/``gz``; unnamed axes stay exact);
+    - a ``{axis: format}`` mapping, a `WireFormat`, or a `WirePolicy`.
+
+    The narrowing per state dtype is decided by :func:`wire_format_for`."""
     import os
 
     from ..utils.exceptions import InvalidArgumentError
 
     if wire_dtype is None:
         wire_dtype = os.environ.get("IGG_HALO_WIRE_DTYPE")
+    if isinstance(wire_dtype, WirePolicy):
+        return wire_dtype
     if isinstance(wire_dtype, str):
         wire_dtype = wire_dtype.strip().lower()
     if wire_dtype in _WIRE_OFF:
         return None
+    if isinstance(wire_dtype, dict):
+        items = list(wire_dtype.items())
+    elif isinstance(wire_dtype, str) and ":" in wire_dtype:
+        items = []
+        for part in wire_dtype.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" not in part:
+                raise InvalidArgumentError(
+                    f"Per-axis wire spec {wire_dtype!r}: entry {part!r} "
+                    "must be '<axis>:<format>' (e.g. 'z:int8,x:f32').")
+            axis, fmt = part.split(":", 1)
+            items.append((axis, fmt))
+    else:
+        return _uniform_policy(_parse_format(wire_dtype))
 
-    import numpy as np
-
-    import jax.numpy as jnp
-
-    named = {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
-             "float16": np.float16, "f16": np.float16, "fp16": np.float16,
-             "float32": np.float32, "f32": np.float32}
-    if isinstance(wire_dtype, str):
-        if wire_dtype not in named:
+    per_dim = [None, None, None]
+    seen = set()
+    for axis, fmt in items:
+        key = str(axis).strip().lower()
+        dim = _AXIS_TOKENS.get(key)
+        if dim is None:
             raise InvalidArgumentError(
-                f"Unsupported halo wire dtype {wire_dtype!r}; supported: "
-                "bfloat16, float16, float32 (or 'off').")
-        return np.dtype(named[wire_dtype])
-    dt = np.dtype(wire_dtype)
-    if dt not in {np.dtype(v) for v in named.values()}:
-        raise InvalidArgumentError(
-            f"Unsupported halo wire dtype {dt}; supported: bfloat16, "
-            "float16, float32 (or 'off').")
-    return dt
+                f"Unknown mesh axis {axis!r} in wire spec (use x/y/z or "
+                "gx/gy/gz).")
+        if dim in seen:
+            raise InvalidArgumentError(
+                f"Mesh axis {axis!r} named twice in wire spec.")
+        seen.add(dim)
+        per_dim[dim] = _parse_format(fmt)
+    if all(f is None for f in per_dim):
+        return None
+    return WirePolicy(tuple(per_dim))
 
 
-def wire_dtype_for(state_dtype, wire):
-    """The on-wire dtype for halo payloads of ``state_dtype`` under resolved
-    wire mode ``wire`` (from :func:`resolve_wire_dtype`), or ``None`` when
-    the payload ships at full precision.
+def _as_policy(wire):
+    """Back-compat: accept a pre-resolved `WirePolicy` (the new contract)
+    or the raw dtype-likes older call sites passed around."""
+    if wire is None or isinstance(wire, WirePolicy):
+        return wire
+    if isinstance(wire, WireFormat):
+        return _uniform_policy(wire)
+    return _uniform_policy(_parse_format(wire))
+
+
+def wire_format_for(state_dtype, wire, dim: int = 0):
+    """The `WireFormat` halo payloads of ``state_dtype`` travel in along
+    grid dimension ``dim`` under resolved policy ``wire`` (from
+    :func:`resolve_wire_dtype`), or ``None`` when the payload ships
+    exact.
 
     Only genuine narrowings of real floating state apply: ints, bools,
-    complex, and states already at or below the wire width are never
-    converted (a widening round trip would waste bandwidth; int/complex
-    conversion would corrupt values)."""
-    if wire is None:
+    complex never convert (quantizing/conversion would corrupt values);
+    a float cast must strictly narrow (a widening round trip would waste
+    bandwidth); quantization applies to every real float state (int8 is
+    1 byte, int4 half of one — below bf16/f16 too)."""
+    policy = _as_policy(wire)
+    if policy is None:
+        return None
+    fmt = policy.for_dim(dim)
+    if fmt is None:
         return None
     import numpy as np
 
@@ -96,10 +279,117 @@ def wire_dtype_for(state_dtype, wire):
     sd = np.dtype(state_dtype)
     if not jnp.issubdtype(sd, jnp.floating):
         return None
-    wd = np.dtype(wire)
-    if wd.itemsize >= sd.itemsize:
+    if fmt.is_quant:
+        return fmt
+    if fmt.dtype.itemsize >= sd.itemsize:
         return None
-    return wd
+    return fmt
+
+
+def wire_dtype_for(state_dtype, wire, dim: int = 0):
+    """The on-wire numpy dtype for halo payloads of ``state_dtype`` under
+    resolved policy ``wire`` along ``dim``, or ``None`` for exact wire
+    (quantized payloads report int8 — the dtype their bytes occupy)."""
+    fmt = wire_format_for(state_dtype, wire, dim)
+    return None if fmt is None else fmt.dtype
+
+
+# ---------------------------------------------------------------------------
+# symmetric per-slab quantization (the int8/int4 wire payload codec)
+# ---------------------------------------------------------------------------
+
+def quant_slab_bytes(cells: int, fmt) -> int:
+    """Wire bytes of one quantized slab of ``cells`` elements, EXCLUDING
+    its `SCALE_BYTES` scale: one byte per element for int8, one per
+    nibble pair (odd slabs pad one nibble) for int4."""
+    cells = int(cells)
+    return (cells + 1) // 2 if fmt.name == "int4" else cells
+
+
+def _pack_int4(q):
+    """Bit-pack int8 values in [-7, 7] two-per-byte (low nibble first;
+    odd-length input pads one zero nibble)."""
+    import jax.numpy as jnp
+
+    if q.size % 2:
+        q = jnp.concatenate([q, jnp.zeros((1,), jnp.int8)])
+    lo = q[0::2] & 0x0F
+    hi = (q[1::2] & 0x0F) << 4
+    return (lo | hi).astype(jnp.int8)
+
+
+def _unpack_int4(b, n: int):
+    """Inverse of `_pack_int4`: ``n`` sign-extended int8 values."""
+    import jax.numpy as jnp
+
+    lo = b & 0x0F
+    hi = (b >> 4) & 0x0F
+    q = jnp.stack([lo, hi], axis=-1).reshape(-1)[:n]
+    return ((q ^ 8) - 8).astype(jnp.int8)
+
+
+def quantize_slab(flat, fmt):
+    """Quantize one flat float slab symmetrically against its own max-abs
+    scale: returns ``(payload_bytes, scale)`` where ``payload_bytes`` is
+    the int8 wire payload (`quant_slab_bytes` long) and ``scale`` the
+    f32[1] per-slab scale (= the slab's max |finite value|).
+
+    The codec is exact for constant slabs (q hits ±L exactly and
+    dequantize computes ``q / L * scale``, so ``±1 * scale`` reproduces
+    the f32 value bit-for-bit) and NaN/Inf-safe: any non-finite element
+    poisons the SLAB's scale to NaN, so the dequantized halo is wholly
+    non-finite — a NaN can narrow to "this slab went bad" but can never
+    be laundered into a plausible finite value (the resilient runtime's
+    guard still trips). All-zero slabs use scale 1 (exact zeros).
+
+    Deliberately, f64 magnitudes BEYOND f32 range poison the same way
+    (finiteness is judged after the f32 cast): the wire format's scale
+    is f32, so such a slab is unrepresentable — poisoning fails loudly
+    at the guard, where a clamped scale would hand back plausible finite
+    halos that are wrong by orders of magnitude. State living out there
+    should not opt into an f32-scaled int8 wire."""
+    import jax.numpy as jnp
+
+    x = flat.astype(jnp.float32)
+    finite = jnp.isfinite(x)
+    amax = jnp.max(jnp.where(finite, jnp.abs(x), 0.0))
+    scale = jnp.where(amax > 0, amax, 1.0)
+    L = fmt.levels
+    q = jnp.clip(jnp.round(jnp.where(finite, x, 0.0) / scale * L),
+                 -L, L).astype(jnp.int8)
+    scale = jnp.where(jnp.all(finite), scale, jnp.float32(jnp.nan))
+    if fmt.name == "int4":
+        q = _pack_int4(q)
+    return q, scale.reshape(1)
+
+
+def dequantize_slab(payload, scale, n: int, fmt, out_dtype):
+    """Inverse of `quantize_slab`: int8 wire ``payload`` + f32 ``scale``
+    -> ``n`` elements of ``out_dtype``."""
+    import jax.numpy as jnp
+
+    q = _unpack_int4(payload, n) if fmt.name == "int4" else payload
+    x = (q.astype(jnp.float32) / fmt.levels) * scale.reshape(())
+    return x.astype(out_dtype)
+
+
+def encode_scales(scales):
+    """Bitcast a list of f32[1] per-slab scales into the int8 tail rider
+    of the quantized flat buffer (`SCALE_BYTES` bytes each)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    v = jnp.concatenate([s.astype(jnp.float32) for s in scales])
+    return lax.bitcast_convert_type(v, jnp.int8).reshape(-1)
+
+
+def decode_scales(tail, n: int):
+    """Inverse of `encode_scales`: int8[4n] tail -> f32[n] scales."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    return lax.bitcast_convert_type(tail.reshape(n, SCALE_BYTES),
+                                    jnp.float32)
 
 
 def stochastic_round_bf16(x, key):

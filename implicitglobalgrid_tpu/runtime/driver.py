@@ -30,8 +30,9 @@ doubles as the chunk drain), builds a `HealthReport`, and
 
 Every recovery path is exercised deterministically by the fault-injection
 species of `runtime/faults.py` in tier-1 tests. Counters for each event
-kind land in the telemetry metrics registry (`igg_health_events_total`;
-`utils.profiling.health_counters()` remains as a shim), and with an active
+kind land in the telemetry metrics registry (the
+``igg_health_events_total{kind=...}`` family, readable via
+``igg.metrics_registry()`` / ``igg.prometheus_snapshot()``), and with an active
 flight recorder (`igg.start_flight_recorder`) the driver streams its whole
 lifecycle — chunk execute/compile splits, guard trips, rollback/restore
 latencies, escalations, elastic restarts — as JSONL events that
@@ -369,11 +370,11 @@ class ResilientRun:
     def _save(self, st, at_step):
         import jax
 
-        from ..utils import profiling
+        from ..telemetry.hooks import record_health_event
         from .faults import CheckpointCorruption, corrupt_checkpoint
 
         path = self.slots.save(st, at_step)
-        profiling.record_health_event("checkpoints_saved")
+        record_health_event("checkpoints_saved")
         due = [f for f in self.pending
                if isinstance(f, CheckpointCorruption)
                and f.save_index == self.saves]
@@ -396,7 +397,7 @@ class ResilientRun:
         self.saves += 1
 
     def _elastic_recover(self, new_dims):
-        from ..utils import profiling
+        from ..telemetry.hooks import record_health_event
         from ..utils.exceptions import ResilienceError
         from .recovery import elastic_restart
 
@@ -407,9 +408,9 @@ class ResilientRun:
             except Exception as e:
                 errors.append(f"{path}: {e}")
                 continue
-            profiling.record_health_event("restores")
+            record_health_event("restores")
             if i > 0:
-                profiling.record_health_event("restore_fallbacks")
+                record_health_event("restore_fallbacks")
             return st, int(at or 0)
         raise ResilienceError(
             "Elastic restart failed on every checkpoint slot:\n  "
@@ -443,8 +444,9 @@ class ResilientRun:
         np = self._np
         record_event = self._record_event
 
-        from ..telemetry.hooks import runner_cache_misses
-        from ..utils import profiling
+        from ..telemetry.hooks import (
+            record_health_event, runner_cache_misses,
+        )
         from ..utils.exceptions import ResilienceError
         from .faults import NaNPoke, ProcessLoss, poke_nan
         from .health import make_guarded_runner, report_from_stats
@@ -474,7 +476,7 @@ class ResilientRun:
                     "ProcessLoss injected with no checkpoint_dir — "
                     "nothing to restart from.")
             self.state, self.step = self._elastic_recover(loss.new_dims)
-            profiling.record_health_event("elastic_restarts")
+            record_health_event("elastic_restarts")
             record_event("elastic_restart", new_dims=list(loss.new_dims),
                          to_step=self.step)
             # the restart rebuilds the chunk program for the NEW
@@ -570,7 +572,7 @@ class ResilientRun:
                                 step_begin=step, step_end=nb)
         self.chunk_idx += 1
         self.reports.append(rep)
-        profiling.record_health_event("chunks")
+        record_health_event("chunks")
         # exec_s covers dispatch through the stats fetch (= the chunk
         # drain); a chunk right after a runner-cache miss also pays the
         # XLA compile inside it — run_report flags those chunks as cold
@@ -619,7 +621,7 @@ class ResilientRun:
             return
 
         # --- guard tripped: bounded-retry rollback ------------------------
-        profiling.record_health_event("guard_trips")
+        record_health_event("guard_trips")
         self.retries += 1
         record_event("guard_trip", step_end=nb, reasons=list(rep.reasons),
                      retries=self.retries)
@@ -639,7 +641,7 @@ class ResilientRun:
                 and self.cur_chunk > self.policy.min_nt_chunk:
             self.cur_chunk = max(self.policy.min_nt_chunk,
                                  self.cur_chunk // 2)
-            profiling.record_health_event("escalations")
+            record_health_event("escalations")
             record_event("escalation", retries=self.retries,
                          nt_chunk=self.cur_chunk, step=step)
             if self.policy.on_escalate is not None:
@@ -647,10 +649,10 @@ class ResilientRun:
                                          "nt_chunk": self.cur_chunk,
                                          "step": step})
         self.state, self.step, fellback = self.slots.restore()
-        profiling.record_health_event("rollbacks")
-        profiling.record_health_event("restores")
+        record_health_event("rollbacks")
+        record_health_event("restores")
         if fellback:
-            profiling.record_health_event("restore_fallbacks")
+            record_health_event("restore_fallbacks")
         record_event("rollback", to_step=self.step, fallback=fellback,
                      retries=self.retries)
 

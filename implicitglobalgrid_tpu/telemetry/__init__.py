@@ -5,8 +5,9 @@ structured, exportable data instead of a `tic`/`toc` printout (the
 reference's whole surface, SURVEY §5.4):
 
 - `registry` — process-local, thread-safe metric families (counters,
-  gauges, fixed-bucket histograms) with labels; absorbs PR-2's
-  `health_counters` (kept as a shim in `utils.profiling`).
+  gauges, fixed-bucket histograms) with labels; absorbed PR-2's
+  `health_counters` (the ``igg_health_events_total`` family — the
+  deprecation shims in `utils.profiling` are retired).
 - `recorder` — the span/event flight recorder: one append-only JSONL
   stream per run (monotonic timestamps, pid/process index, run id),
   streamed by `runtime/driver.py`, the runner caches, and the

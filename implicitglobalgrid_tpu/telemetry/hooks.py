@@ -16,6 +16,7 @@ from .recorder import record_event
 from .registry import metrics_registry
 
 __all__ = ["note_runner_cache", "account_halo_exchange",
+           "record_health_event",
            "observe_checkpoint", "observe_snapshot", "note_io_queue",
            "observe_reducers", "note_heartbeat", "observe_perf",
            "note_metrics_server_port", "observe_audit",
@@ -25,6 +26,7 @@ __all__ = ["note_runner_cache", "account_halo_exchange",
 
 # Metric family names (the exported contract; see docs/observability.md).
 RUNNER_CACHE = "igg_runner_cache_total"
+HEALTH_EVENTS = "igg_health_events_total"
 HALO_EXCHANGES = "igg_halo_exchanges_total"
 HALO_PPERMUTES = "igg_halo_ppermutes_total"
 HALO_WIRE_BYTES = "igg_halo_wire_bytes_total"
@@ -78,6 +80,21 @@ def note_runner_cache(result: str, build_s: float | None = None) -> None:
         record_event("runner_cache", result=result)
     else:
         record_event("runner_cache", result=result, build_s=build_s)
+
+
+def record_health_event(kind: str, n: int = 1) -> None:
+    """Bump the resilient-runtime ``igg_health_events_total{kind=...}``
+    counter by ``n`` (`runtime.run_resilient`: kinds include ``chunks``,
+    ``guard_trips``, ``rollbacks``, ``checkpoints_saved``, ``restores``,
+    ``restore_fallbacks``, ``elastic_restarts``, ``escalations``). Read
+    the family via ``igg.metrics_registry()`` or
+    ``igg.prometheus_snapshot()`` — the PR-2 `health_counters` dict API
+    was retired after two majors of deprecation."""
+    metrics_registry().counter(
+        HEALTH_EVENTS,
+        "Resilient-runtime events by kind (chunks, guard_trips, rollbacks, "
+        "checkpoints_saved, restores, restore_fallbacks, elastic_restarts, "
+        "escalations).", ("kind",)).inc(int(n), kind=str(kind))
 
 
 def account_halo_exchange(plan: dict) -> None:

@@ -19,8 +19,9 @@ Families are registered lazily and idempotently::
 
 Export with `telemetry.prometheus_snapshot()`; `reset_metrics()` zeros every
 series for test isolation (family registrations survive, so cached family
-handles stay valid). PR-2's `utils.profiling.health_counters` is now a thin
-shim over the ``igg_health_events_total`` family here.
+handles stay valid). PR-2's `utils.profiling.health_counters` dict became
+the ``igg_health_events_total`` family here (its deprecation shims are
+retired; `telemetry.hooks.record_health_event` is the writer).
 """
 
 from __future__ import annotations

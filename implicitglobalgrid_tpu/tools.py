@@ -436,9 +436,11 @@ def _cli(argv=None) -> int:
                           "audits lints only, contract+crosscheck "
                           "skipped)")
     aud.add_argument("--wire-dtype", default=None,
-                     help="reduced-precision wire dtype the exchange was "
-                          "built with (audits the downcast reached the "
-                          "wire)")
+                     help="reduced-precision wire format the exchange was "
+                          "built with — float casts (bfloat16/float16), "
+                          "quantized (int8/int4), or a per-axis policy "
+                          "like z:int8,x:f32 (audits the narrowing "
+                          "reached each axis's wire)")
     aud.add_argument("--lowered", action="store_true",
                      help="audit the pre-backend StableHLO instead of "
                           "backend-optimized HLO (where wire downcasts "

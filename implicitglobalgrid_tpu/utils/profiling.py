@@ -31,57 +31,14 @@ from __future__ import annotations
 import contextlib
 import re
 
-__all__ = ["trace", "annotate", "overlap_stats", "op_breakdown",
-           "health_counters", "record_health_event", "reset_health_counters"]
+__all__ = ["trace", "annotate", "overlap_stats", "op_breakdown"]
 
-
-# ---------------------------------------------------------------------------
-# Resilient-runtime health counters — BACK-COMPAT SHIM over the telemetry
-# metrics registry (`telemetry/registry.py`). The PR-2 ad-hoc dict grew into
-# the ``igg_health_events_total{kind=...}`` counter family; these three
-# functions keep the original API working (tests, operator scrapers) and
-# are the documented deprecation path: new code should use
-# ``igg.metrics_registry()`` / ``igg.prometheus_snapshot()`` directly.
-# ---------------------------------------------------------------------------
-
-HEALTH_METRIC = "igg_health_events_total"
-_HEALTH_HELP = ("Resilient-runtime events by kind (chunks, guard_trips, "
-                "rollbacks, checkpoints_saved, restores, restore_fallbacks, "
-                "elastic_restarts, escalations).")
-
-
-def record_health_event(kind: str, n: int = 1) -> None:
-    """Bump the ``kind`` counter by ``n`` (used by `runtime.run_resilient`:
-    kinds include ``chunks``, ``guard_trips``, ``rollbacks``,
-    ``checkpoints_saved``, ``restores``, ``restore_fallbacks``,
-    ``elastic_restarts``, ``escalations``). Now a shim over the telemetry
-    registry's `HEALTH_METRIC` counter family."""
-    from ..telemetry import metrics_registry
-
-    metrics_registry().counter(HEALTH_METRIC, _HEALTH_HELP,
-                               ("kind",)).inc(int(n), kind=str(kind))
-
-
-def health_counters() -> dict:
-    """Snapshot of the resilient-runtime counters (a copy — safe to
-    mutate). DEPRECATED alias for reading the registry's
-    ``igg_health_events_total`` family; prefer ``igg.metrics_registry()``
-    or ``igg.prometheus_snapshot()``."""
-    from ..telemetry import metrics_registry
-
-    fam = metrics_registry().get(HEALTH_METRIC)
-    if fam is None:
-        return {}
-    return {labels["kind"]: int(v) for labels, v in fam.samples()}
-
-
-def reset_health_counters() -> None:
-    """Zero the health counters only (test isolation; scrape-and-reset
-    exporters). Other telemetry metric families are untouched — use
-    ``igg.reset_metrics()`` to zero everything."""
-    from ..telemetry import metrics_registry
-
-    metrics_registry().reset(HEALTH_METRIC)
+# The PR-2 `health_counters`/`record_health_event`/`reset_health_counters`
+# shims that lived here were RETIRED after two majors of deprecation
+# notice (PRs 3-9): the resilient runtime records through
+# `telemetry.hooks.record_health_event` and readers consume the
+# ``igg_health_events_total{kind=...}`` family via
+# ``igg.metrics_registry()`` / ``igg.prometheus_snapshot()``.
 
 
 @contextlib.contextmanager

@@ -40,3 +40,21 @@ def _clean_grid():
     if igg.grid_is_initialized():
         igg.finalize_global_grid()
     topology._retained_epochs.clear()
+
+
+def health_counters_from_registry():
+    """The ``igg_health_events_total{kind=...}`` family as a dict — the
+    registry IS the API since the PR-2 shims were retired (shared by
+    test_resilience.py / test_service.py)."""
+    import implicitglobalgrid_tpu as igg
+
+    fam = igg.metrics_registry().get("igg_health_events_total")
+    if fam is None:
+        return {}
+    return {labels["kind"]: int(v) for labels, v in fam.samples()}
+
+
+def reset_health_counters_in_registry():
+    import implicitglobalgrid_tpu as igg
+
+    igg.metrics_registry().reset("igg_health_events_total")

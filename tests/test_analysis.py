@@ -376,6 +376,114 @@ def test_lint_wire_downcast_partial_regression_flagged():
                      rules=("wire-downcast-missing",)) == []
 
 
+def test_lint_wire_downcast_per_axis_policy_asymmetry():
+    """REGRESSION (ISSUE 10 satellite): under a PER-AXIS policy a float
+    payload at full width on an axis the policy leaves exact is LEGAL —
+    the old global `wire_dtype_for` width check flagged it. With
+    ``wire_axes``+``routes`` the lint judges each permute against ITS
+    axis: an s8 payload on the quantized axis and an f32 payload on the
+    exact axis are both clean, while a stale f32 payload on the
+    quantized axis still flags (host-only: explicit route table, no
+    grid)."""
+    routes = {"gx": (frozenset({(0, 1), (1, 0)}),),
+              "gz": (frozenset({(0, 2), (2, 0)}),)}
+    mixed_ok = _synth(
+        "  %p0 = f32[4,4] parameter(0)\n"
+        "  %s0 = f32[1,4] slice(f32[4,4] %p0), slice={[0:1], [0:4]}\n"
+        "  %cpx = f32[1,4] collective-permute(f32[1,4] %s0), "
+        "channel_id=1, source_target_pairs={{0,1},{1,0}}\n"
+        "  %q = s8[8] bitcast(f32[1,4] %s0)\n"
+        "  %cpz = s8[8] collective-permute(s8[8] %q), "
+        "channel_id=2, source_target_pairs={{0,2},{2,0}}\n"
+        "  ROOT %t = (f32[1,4], s8[8]) tuple(f32[1,4] %cpx, s8[8] %cpz)",
+        result="(f32[1,4], s8[8])")
+    cfg = LintConfig(state_dtypes=("f32",), wire_dtype="f32",
+                     wire_axes={"gz": "s8"}, routes=routes)
+    assert run_lints(parse_text(mixed_ok), config=cfg,
+                     rules=("wire-downcast-missing",)) == []
+    # stale: the z permute still carries f32 under the z:int8 policy
+    stale = _synth(
+        "  %p0 = f32[4,4] parameter(0)\n"
+        "  %s0 = f32[1,4] slice(f32[4,4] %p0), slice={[0:1], [0:4]}\n"
+        "  %cpx = f32[1,4] collective-permute(f32[1,4] %s0), "
+        "channel_id=1, source_target_pairs={{0,1},{1,0}}\n"
+        "  %s1 = f32[1,4] slice(f32[4,4] %p0), slice={[3:4], [0:4]}\n"
+        "  %cpz = f32[1,4] collective-permute(f32[1,4] %s1), "
+        "channel_id=2, source_target_pairs={{0,2},{2,0}}\n"
+        "  ROOT %t = (f32[1,4], f32[1,4]) tuple(f32[1,4] %cpx, "
+        "f32[1,4] %cpz)",
+        result="(f32[1,4], f32[1,4])")
+    out = run_lints(parse_text(stale), config=cfg,
+                    rules=("wire-downcast-missing",))
+    assert [f.rule for f in out] == ["wire-downcast-missing"]
+    assert out[0].details["stale"] == 1  # only the z permute
+    # a MALFORMED policy spec must raise loudly, not silently disable
+    # the lint via the legacy-string fallback (which would judge every
+    # payload against a width-4 default and flag nothing); the known
+    # legacy HLO spellings the policy parser doesn't know still pass
+    from implicitglobalgrid_tpu.analysis import default_lint_config
+
+    for bad in ("w:int8", "z:int3", "int3"):
+        with pytest.raises(InvalidArgumentError):
+            default_lint_config(wire_dtype=bad)
+    assert default_lint_config(wire_dtype="f64").wire_dtype == "f64"
+    # NO routes (host-only dump audit, or an unattributable permute):
+    # a per-axis policy can never soundly flag a full-width payload —
+    # it may belong to an exact-by-policy axis — so nothing flags (the
+    # old widest-format fallback judged everything against one width)
+    cfg_noroutes = LintConfig(state_dtypes=("f32",), wire_dtype="s8",
+                              wire_axes={"gz": "s8"}, routes=None)
+    assert run_lints(parse_text(stale), config=cfg_noroutes,
+                     rules=("wire-downcast-missing",)) == []
+    # live-grid path: `default_lint_config` builds wire_axes + routes
+    # from a policy spec when a grid is initialized
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.analysis import default_lint_config
+
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=1, dimz=4, periodx=1,
+                         periodz=1, quiet=True)
+    try:
+        live = default_lint_config(state_dtypes=("f32",),
+                                   wire_dtype="z:int8,x:f32")
+        assert live.wire_axes == {"gx": "f32", "gz": "s8"}
+        assert sorted(live.routes) == ["gx", "gz"]
+        assert live.wire_dtype == "f32"  # widest fallback, never false-flags
+    finally:
+        igg.finalize_global_grid()
+
+
+def test_parse_int8_quant_fixture():
+    """Golden quantized single-axis exchange (dims=(8,1,1) periodic,
+    ``wire_dtype="int8"``, OPTIMIZED HLO — int8 payloads survive the CPU
+    backend, unlike bf16): one permute pair whose payloads are the packed
+    s8[68] buffer = 64 slab cells + 4 bitcast scale bytes, 544 B on the
+    wire per direction — 4x fewer slab bytes than the f32 fixture's
+    s8-equivalent, byte-exact against `quant_slab_bytes` + SCALE_BYTES."""
+    from implicitglobalgrid_tpu.ops.precision import (
+        SCALE_BYTES, WireFormat, quant_slab_bytes,
+    )
+
+    ir = _fixture("exchange_int8_quant.hlo.txt")
+    assert ir.dialect == "hlo"
+    assert len(ir.permutes) == 2
+    assert not ir.all_reduces and not ir.all_gathers
+    expect = quant_slab_bytes(8 * 8, WireFormat("int8")) + SCALE_BYTES
+    for op in ir.permutes:
+        pay = ir.payload_of(op)
+        assert pay.dtype == "s8" and pay.cells == expect == 68
+        assert ir.wire_bytes_of(op) == expect * 8
+        pairs = op.attrs["source_target_pairs"]
+        assert frozenset(pairs) in (_RING_P, _RING_M)
+    axes = measure_axes(ir, _ROUTES)
+    assert axes == {"gx": {"permutes": 2, "pairs": 16,
+                           "wire_bytes": 2 * expect * 8,
+                           "dtypes": ("s8",)}}
+    # vs the exact fixture: 4 bytes/cell -> 1 + scales = 3.76x down
+    exact = _fixture("exchange_single_axis.hlo.txt")
+    exact_bytes = sum(exact.wire_bytes_of(p) for p in exact.permutes)
+    assert exact_bytes / (2 * expect * 8) > 3.5
+
+
 def test_run_lints_unknown_rule_raises():
     ir = _fixture("exchange_all_self.hlo.txt")
     with pytest.raises(InvalidArgumentError):

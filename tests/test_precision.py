@@ -111,3 +111,168 @@ def test_sr_deterministic_per_seed():
     c = _final(jnp.bfloat16, sr=True, nt=40, seed=8)
     assert np.array_equal(a, b)       # same seed -> same trajectory
     assert not np.array_equal(a, c)   # the rounding is actually stochastic
+
+
+# ---------------------------------------------------------------------------
+# Quantized wire codec (`ops/precision.py`: WirePolicy + per-slab int8/int4)
+# ---------------------------------------------------------------------------
+
+quant = pytest.mark.quant
+
+
+@quant
+def test_wire_policy_parsing_and_roundtrip():
+    from implicitglobalgrid_tpu.ops.precision import (
+        WireFormat, WirePolicy, resolve_wire_dtype, wire_format_for,
+    )
+    from implicitglobalgrid_tpu.utils.exceptions import InvalidArgumentError
+
+    # uniform spellings (strings and dtypes) and the off forms
+    assert resolve_wire_dtype("off") is None
+    assert resolve_wire_dtype("") is None
+    p8 = resolve_wire_dtype("int8")
+    assert isinstance(p8, WirePolicy) and str(p8) == "int8"
+    assert all(f == WireFormat("int8") for f in p8.per_dim)
+    assert str(resolve_wire_dtype(np.float16)) == "float16"
+    # per-axis syntax: x/y/z and gx/gy/gz both address dims; unnamed
+    # axes stay exact; the canonical string round-trips
+    pm = resolve_wire_dtype("z:int8,x:f32")
+    assert pm.for_dim(2) == WireFormat("int8")
+    assert pm.for_dim(0) == WireFormat("float32")
+    assert pm.for_dim(1) is None
+    assert str(resolve_wire_dtype(str(pm))) == str(pm) == "x:float32,z:int8"
+    assert str(resolve_wire_dtype("gz:int4")) == "z:int4"
+    assert str(resolve_wire_dtype({"z": "int8"})) == "z:int8"
+    # errors: unknown format, unknown axis, duplicate axis, bare token
+    for bad in ("int3", "z:int3", "w:int8", "z:int8,gz:int4", "z:int8,f32"):
+        with pytest.raises(InvalidArgumentError):
+            resolve_wire_dtype(bad)
+    # narrowing rules: quant applies to every real float; casts must
+    # strictly narrow; non-floats never convert
+    assert wire_format_for(np.float32, pm, 2) == WireFormat("int8")
+    assert wire_format_for(np.float32, pm, 0) is None   # f32 cast: no-op
+    assert wire_format_for(np.float64, pm, 0) == WireFormat("float32")
+    assert wire_format_for(np.int32, p8, 2) is None
+    assert wire_format_for(np.float16, p8, 0) == WireFormat("int8")
+
+
+@quant
+def test_quantize_slab_constant_exact_and_bounded():
+    """Per-slab max-abs scaling: a constant slab round-trips EXACTLY
+    (q hits +/-L and dequant computes (q/L)*scale = +/-scale), an
+    arbitrary slab stays within scale/(2L) of the source, and all-zero
+    slabs dequantize to exact zeros (scale 1 guard, no 0/0)."""
+    import jax.numpy as jnp
+
+    from implicitglobalgrid_tpu.ops.precision import (
+        WireFormat, dequantize_slab, quant_slab_bytes, quantize_slab,
+    )
+
+    rng = np.random.default_rng(21)
+    x = jnp.asarray(rng.standard_normal(513) * 3.7, jnp.float32)
+    for name, L in (("int8", 127), ("int4", 7)):
+        fmt = WireFormat(name)
+        q, s = quantize_slab(x, fmt)
+        assert q.dtype == jnp.int8 and q.size == quant_slab_bytes(513, fmt)
+        assert float(s[0]) == float(jnp.max(jnp.abs(x)))
+        y = dequantize_slab(q, s, 513, fmt, jnp.float32)
+        assert float(jnp.max(jnp.abs(y - x))) <= float(s[0]) / (2 * L) * 1.001
+        # constant slabs (either sign) are exact
+        for c in (2.7182817, -0.3333333):
+            cx = jnp.full((9,), c, jnp.float32)
+            cq, cs = quantize_slab(cx, fmt)
+            assert np.array_equal(
+                np.asarray(dequantize_slab(cq, cs, 9, fmt, jnp.float32)),
+                np.asarray(cx))
+        zq, zs = quantize_slab(jnp.zeros((4,), jnp.float32), fmt)
+        assert float(zs[0]) == 1.0
+        assert np.all(np.asarray(
+            dequantize_slab(zq, zs, 4, fmt, jnp.float32)) == 0.0)
+
+
+@quant
+def test_quantize_slab_nonfinite_poisons_slab():
+    """NaN/Inf propagation: any non-finite element poisons the SLAB's
+    scale to NaN, so the dequantized halo is wholly non-finite — a NaN
+    can coarsen to slab granularity but can never be laundered into a
+    plausible finite value (the health guard still trips)."""
+    import jax.numpy as jnp
+
+    from implicitglobalgrid_tpu.ops.precision import (
+        WireFormat, dequantize_slab, quantize_slab,
+    )
+
+    for fmt in (WireFormat("int8"), WireFormat("int4")):
+        for poison in (np.nan, np.inf, -np.inf):
+            x = jnp.asarray([1.0, poison, -2.0, 0.5], jnp.float32)
+            q, s = quantize_slab(x, fmt)
+            assert np.isnan(float(s[0]))
+            y = np.asarray(dequantize_slab(q, s, 4, fmt, jnp.float32))
+            assert not np.isfinite(y).any()
+    # DELIBERATE: finite f64 magnitudes beyond f32 range poison too —
+    # the wire scale is f32, so the slab is unrepresentable; poisoning
+    # fails loudly at the guard where a clamped scale would ship halos
+    # wrong by orders of magnitude (see the quantize_slab docstring)
+    big = jnp.asarray([1e300, 1.0], jnp.float64)
+    q, s = quantize_slab(big, WireFormat("int8"))
+    assert np.isnan(float(s[0]))
+    y = np.asarray(dequantize_slab(q, s, 2, WireFormat("int8"), jnp.float64))
+    assert not np.isfinite(y).any()
+
+
+@quant
+def test_int4_pack_unpack_parity_with_int8():
+    """Bit-packed int4 is int8 with 4-bit levels, not a different codec:
+    on values int4 represents exactly (multiples of scale/7) the two
+    formats agree bit-for-bit, and odd-length slabs survive the pad
+    nibble."""
+    import jax.numpy as jnp
+
+    from implicitglobalgrid_tpu.ops.precision import (
+        WireFormat, dequantize_slab, quantize_slab,
+    )
+
+    from implicitglobalgrid_tpu.ops.precision import (
+        _pack_int4, _unpack_int4,
+    )
+
+    f8, f4 = WireFormat("int8"), WireFormat("int4")
+    # the nibble pack is a pure bijection on [-7, 7], odd lengths padded
+    for n in (7, 8):
+        q = jnp.asarray(np.arange(n) % 15 - 7, jnp.int8)
+        packed = _pack_int4(q)
+        assert packed.size == (n + 1) // 2
+        assert np.array_equal(np.asarray(_unpack_int4(packed, n)),
+                              np.asarray(q))
+    # 7 exactly-representable levels incl. both extremes, odd length:
+    # int4 round-trips them bit-exactly, int8 agrees wherever ITS levels
+    # are exact too (the two formats share one codec, only L differs)
+    x = jnp.asarray([7, -7, 3, 0, -1, 5, -4], jnp.float32) / 7 * 2.5
+    q8, s8_ = quantize_slab(x, f8)
+    q4, s4_ = quantize_slab(x, f4)
+    assert float(s8_[0]) == float(s4_[0]) == 2.5
+    assert q4.size == 4 and q8.size == 7  # (7+1)//2 packed bytes
+    y8 = np.asarray(dequantize_slab(q8, s8_, 7, f8, jnp.float32))
+    y4 = np.asarray(dequantize_slab(q4, s4_, 7, f4, jnp.float32))
+    assert np.array_equal(y4, np.asarray(x))  # exact levels round-trip
+    assert np.abs(y8 - np.asarray(x)).max() <= 2.5 / (2 * 127) * 1.001
+    assert np.array_equal(y8[[0, 1, 3]], y4[[0, 1, 3]])  # shared levels
+
+
+@quant
+def test_scales_codec_roundtrip():
+    """The per-slab f32 scales ride the int8 buffer bitcast: bit-exact
+    round-trip, NaN included (the poison marker must survive the wire)."""
+    import jax.numpy as jnp
+
+    from implicitglobalgrid_tpu.ops.precision import (
+        SCALE_BYTES, decode_scales, encode_scales,
+    )
+
+    vals = [1.5, np.pi, 1e-30, np.nan]
+    scales = [jnp.asarray([v], jnp.float32) for v in vals]
+    buf = encode_scales(scales)
+    assert buf.dtype == jnp.int8 and buf.size == SCALE_BYTES * len(vals)
+    dec = np.asarray(decode_scales(buf, len(vals)))
+    ref = np.asarray(vals, np.float32)
+    assert np.array_equal(dec.view(np.uint32), ref.view(np.uint32))

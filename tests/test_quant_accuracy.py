@@ -1,0 +1,80 @@
+"""Accuracy tier of the quantized halo wire (ISSUE 10).
+
+The exact wire stays bitwise tier-1-contracted (`tests/test_update_halo.py`);
+the quantized path gets an ACCURACY-BOUNDED tier instead, riding the
+`bench_f64_accuracy.py` harness: diffusion3D advanced with per-slab-scaled
+int8 halo payloads must track the exact-wire trajectory within a documented
+drift bound — F64_ACCURACY.json records `int8_wire` max_rel orders of
+magnitude inside the `bf16_xla` row's 0.85 (the acceptance bar is 10x;
+the documented bound asserted here is 0.02, ~40x). One fast representative
+runs in tier-1 (`quant` marker); the full bench config (48³ local → 92³
+interior, nt=400, f64 ground truth) rides `slow`.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.models import init_diffusion3d, run_diffusion
+
+pytestmark = pytest.mark.quant
+
+# The documented drift bound for diffusion3D with int8 halo wire (max_rel
+# vs the exact-wire trajectory): docs/performance.md error-model table.
+# bf16_xla storage records 0.85 in F64_ACCURACY.json — the quantized WIRE
+# must sit at least 10x inside it (acceptance); measured ~6e-3 at both the
+# fast and the full bench config, bounded here with ~3x slack.
+INT8_WIRE_MAX_REL = 0.02
+
+
+def _final(wire, nx, nt, dtype=np.float32):
+    igg.init_global_grid(nx, nx, nx, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    # scope the wire env var like audit_model does: the exact baseline
+    # (wire=None) must run with it CLEARED even if the invoking shell
+    # exported one, and the caller's value is restored after
+    saved = os.environ.pop("IGG_HALO_WIRE_DTYPE", None)
+    try:
+        if wire is not None:
+            os.environ["IGG_HALO_WIRE_DTYPE"] = wire
+        T, Cp, p = init_diffusion3d(dtype=dtype)
+        out = run_diffusion(T, Cp, p, nt, nt_chunk=max(1, nt // 4))
+        return np.asarray(igg.gather_interior(out), np.float64)
+    finally:
+        if saved is None:
+            os.environ.pop("IGG_HALO_WIRE_DTYPE", None)
+        else:
+            os.environ["IGG_HALO_WIRE_DTYPE"] = saved
+        igg.finalize_global_grid()
+
+
+def test_int8_wire_drift_within_documented_bound_fast():
+    """Fast tier-1 representative (24³, nt=100): the int8 halo wire's
+    whole-trajectory drift vs the exact-wire f32 run stays within the
+    documented bound, actually quantizes, and the per-axis policy's
+    drift is bounded by the all-axes one (fewer quantized links can only
+    shrink the error)."""
+    exact = _final(None, 24, 100)
+    q8 = _final("int8", 24, 100)
+    scale = np.abs(exact).max()
+    drift = np.abs(q8 - exact).max() / scale
+    assert 0 < drift < INT8_WIRE_MAX_REL, drift
+    z8 = _final("z:int8", 24, 100)
+    drift_z = np.abs(z8 - exact).max() / scale
+    assert 0 < drift_z <= drift * 1.05, (drift_z, drift)
+
+
+@pytest.mark.slow
+def test_int8_wire_drift_full_bench_config():
+    """THE acceptance assertion at the bench config (48³ local → 92³
+    interior, nt=400, f64 ground truth — the exact F64_ACCURACY.json
+    `int8_wire` leg): documented bound 0.02, at least 10x inside the
+    recorded bf16_xla 0.85 row. Slow: two full 400-step runs, one in
+    f64."""
+    f64 = _final(None, 48, 400, dtype=np.float64)
+    q8 = _final("int8", 48, 400)
+    drift = np.abs(q8 - f64).max() / np.abs(f64).max()
+    assert 0 < drift < INT8_WIRE_MAX_REL, drift
+    assert drift < 0.85 / 10  # the ISSUE acceptance bar, explicitly

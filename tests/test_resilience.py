@@ -13,6 +13,11 @@ from implicitglobalgrid_tpu.utils.exceptions import (
     InvalidArgumentError, ResilienceError,
 )
 
+from conftest import (
+    health_counters_from_registry as _health_counters,
+    reset_health_counters_in_registry as _reset_health_counters,
+)
+
 
 def _init(dimx=2, dimy=2, dimz=1):
     igg.init_global_grid(6, 6, 6, dimx=dimx, dimy=dimy, dimz=dimz,
@@ -64,10 +69,15 @@ def test_public_api_exports():
                 "RecoveryPolicy", "NaNPoke", "CheckpointCorruption",
                 "ProcessLoss", "poke_nan", "corrupt_checkpoint",
                 "elastic_restart", "restore_checkpoint_elastic",
-                "saved_topology", "elastic_local_size", "health_counters",
-                "record_health_event", "reset_health_counters"):
+                "saved_topology", "elastic_local_size"):
         assert hasattr(igg, sym), sym
         assert sym in igg.__all__, sym
+    # the PR-2 health-counter shims are RETIRED (two majors of notice):
+    # the igg_health_events_total registry family is the only API
+    for gone in ("health_counters", "record_health_event",
+                 "reset_health_counters"):
+        assert not hasattr(igg, gone), gone
+        assert gone not in igg.__all__, gone
 
 
 def test_public_api_importable_in_subprocess():
@@ -113,20 +123,20 @@ def test_unsupervised_equivalence_and_reports(tmp_path):
 @pytest.mark.slow
 def test_health_counters_record_and_reset(tmp_path):
     """Full-run counter sweep (slow: one extra supervised run+compile).
-    The fast tier keeps the shim/reset contract in
-    test_telemetry.py::test_health_counters_shim_over_registry and the
+    The fast tier keeps the registry-family contract in
+    test_telemetry.py::test_health_events_family_in_registry and the
     per-path counter asserts inside the fault-matrix tests."""
-    igg.reset_health_counters()
+    _reset_health_counters()
     _init()
     step, state = _diffusion_step()
     igg.run_resilient(step, state, 10, nt_chunk=5, key="resil_cnt",
                       checkpoint_dir=str(tmp_path / "ck"))
-    c = igg.health_counters()
+    c = _health_counters()
     assert c["chunks"] == 2
     assert c["checkpoints_saved"] == 3  # initial + one per chunk boundary
     assert "guard_trips" not in c
-    igg.reset_health_counters()
-    assert igg.health_counters() == {}
+    _reset_health_counters()
+    assert _health_counters() == {}
 
 
 def test_terminal_checkpoint_saved_off_cadence(tmp_path):
@@ -154,11 +164,11 @@ def test_terminal_checkpoint_on_cadence_single_save(tmp_path):
 
     _init()
     step, state = _diffusion_step()
-    igg.reset_health_counters()
+    _reset_health_counters()
     out, reports = igg.run_resilient(
         step, dict(state), 10, nt_chunk=5, key="resil_final2",
         checkpoint_dir=str(tmp_path / "ck2"), checkpoint_every=5)
-    assert igg.health_counters()["checkpoints_saved"] == 3  # init + 5 + 10
+    assert _health_counters()["checkpoints_saved"] == 3  # init + 5 + 10
     st, at, _ = _CheckpointSlots(str(tmp_path / "ck2")).restore()
     assert at == 10
 
@@ -215,7 +225,7 @@ def test_nan_injection_rollback_bit_identical(tmp_path):
     P_ref = _reference_run(tmp_path)
 
     _init()
-    igg.reset_health_counters()
+    _reset_health_counters()
     step, state = _diffusion_step()
     out, reports = igg.run_resilient(
         step, state, 20, nt_chunk=5, key="resil_nan",
@@ -229,7 +239,7 @@ def test_nan_injection_rollback_bit_identical(tmp_path):
     assert tripped[0].step_begin == 12 and tripped[0].step_end <= 17
     assert tripped[0].reasons == ("nonfinite:T",)
     assert tripped[0].nonfinite["T"] > 0
-    c = igg.health_counters()
+    c = _health_counters()
     assert c["guard_trips"] == 1 and c["rollbacks"] == 1
     assert np.array_equal(igg.gather_interior(out["T"]), P_ref)
 
@@ -247,7 +257,7 @@ def test_process_loss_elastic_restart_identical(tmp_path):
     P_ref = _reference_run(tmp_path)
 
     _init()
-    igg.reset_health_counters()
+    _reset_health_counters()
     step, state = _diffusion_step()
     igg.start_flight_recorder(str(tmp_path / "fr.jsonl"))
     try:
@@ -260,7 +270,7 @@ def test_process_loss_elastic_restart_identical(tmp_path):
 
     gg = igg.global_grid()
     assert tuple(int(d) for d in gg.dims) == (1, 2, 2)  # run ended elastic
-    c = igg.health_counters()
+    c = _health_counters()
     assert c["elastic_restarts"] == 1
     assert np.array_equal(igg.gather_interior(out["T"]), P_ref)
     audits = [e for e in igg.read_flight_events(str(tmp_path / "fr.jsonl"))
@@ -278,14 +288,14 @@ def test_nan_after_elastic_restart_rolls_back_on_new_grid(tmp_path):
     P_ref = _reference_run(tmp_path)
 
     _init()
-    igg.reset_health_counters()
+    _reset_health_counters()
     step, state = _diffusion_step()
     out, reports = igg.run_resilient(
         step, state, 20, nt_chunk=5, key="resil_combo",
         checkpoint_dir=str(tmp_path / "ck"),
         faults=[igg.ProcessLoss(step=13, new_dims=(1, 2, 2)),
                 igg.NaNPoke(step=14, name="T")])
-    c = igg.health_counters()
+    c = _health_counters()
     assert c["elastic_restarts"] == 1
     assert c["guard_trips"] == 1 and c["rollbacks"] == 1
     assert np.array_equal(igg.gather_interior(out["T"]), P_ref)
@@ -299,14 +309,14 @@ def test_checkpoint_corruption_falls_back_to_other_slot(tmp_path):
     P_ref = _reference_run(tmp_path)
 
     _init()
-    igg.reset_health_counters()
+    _reset_health_counters()
     step, state = _diffusion_step()
     out, reports = igg.run_resilient(
         step, state, 20, nt_chunk=5, key="resil_corrupt",
         checkpoint_dir=str(tmp_path / "ck"),
         faults=[igg.CheckpointCorruption(save_index=2, kind="bitflip"),
                 igg.NaNPoke(step=12, name="T")])
-    c = igg.health_counters()
+    c = _health_counters()
     assert c["rollbacks"] == 1 and c["restore_fallbacks"] == 1
     assert np.array_equal(igg.gather_interior(out["T"]), P_ref)
 
@@ -347,7 +357,7 @@ def test_persistent_failure_escalates_then_exhausts(tmp_path):
         return {"T": out["T"].at[0, 0, 0].set(float("nan")),
                 "Cp": out["Cp"]}
 
-    igg.reset_health_counters()
+    _reset_health_counters()
     seen = []
     with pytest.raises(ResilienceError, match="retry budget"):
         igg.run_resilient(
@@ -355,7 +365,7 @@ def test_persistent_failure_escalates_then_exhausts(tmp_path):
             checkpoint_dir=str(tmp_path / "ck"),
             policy=igg.RecoveryPolicy(max_retries=3, shrink_chunk_after=2,
                                       on_escalate=seen.append))
-    c = igg.health_counters()
+    c = _health_counters()
     assert c["guard_trips"] == 4  # max_retries + the final fatal trip
     assert c["escalations"] >= 1
     assert seen and seen[0]["nt_chunk"] < 8  # hook saw the shrunk chunk

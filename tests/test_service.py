@@ -29,6 +29,11 @@ from implicitglobalgrid_tpu.utils.exceptions import (
     InvalidArgumentError, ResilienceError,
 )
 
+from conftest import (
+    health_counters_from_registry as _health_counters,
+    reset_health_counters_in_registry as _reset_health_counters,
+)
+
 GRID_A = dict(nx=6, ny=6, nz=6, dimx=2, dimy=2, dimz=1)
 GRID_B = dict(nx=8, ny=8, nz=8, dimx=2, dimy=2, dimz=1)
 
@@ -247,7 +252,7 @@ def test_three_jobs_multiplexed_fault_isolated_bit_identical(tmp_path):
     ref_a = _solo_reference(GRID_A, 12, 4)
     ref_b = _solo_reference(GRID_B, 12, 4)
 
-    igg.reset_health_counters()
+    _reset_health_counters()
     d = str(tmp_path / "svc")
     with MeshScheduler(policy="round_robin", flight_dir=d) as sched:
         sched.submit(_job("a", GRID_A, 12, 4))
@@ -263,7 +268,7 @@ def test_three_jobs_multiplexed_fault_isolated_bit_identical(tmp_path):
         assert st["states"] == {"done": 3}
         # isolation: exactly ONE guard trip in the whole service, and it
         # belongs to C (A and B sailed through)
-        c = igg.health_counters()
+        c = _health_counters()
         assert c["guard_trips"] == 1 and c["rollbacks"] == 1
         assert all(r.ok for r in sched.job("a").reports)
         assert all(r.ok for r in sched.job("b").reports)
@@ -334,7 +339,7 @@ def test_corrupted_checkpoint_isolated_to_one_tenant(tmp_path):
     slot, recomputes — neighbors untouched, all bit-identical."""
     ref_a = _solo_reference(GRID_A, 12, 4)
 
-    igg.reset_health_counters()
+    _reset_health_counters()
     with MeshScheduler(policy="round_robin") as sched:
         sched.submit(_job("a", GRID_A, 12, 4))
         sched.submit(_job(
@@ -344,7 +349,7 @@ def test_corrupted_checkpoint_isolated_to_one_tenant(tmp_path):
                     igg.NaNPoke(step=8, name="T"))))
         sched.run()
         assert sched.status()["states"] == {"done": 2}
-        c = igg.health_counters()
+        c = _health_counters()
         assert c["restore_fallbacks"] == 1
         assert np.array_equal(_interior(sched, "a"), ref_a)
         assert np.array_equal(_interior(sched, "c"), ref_a)
@@ -360,7 +365,7 @@ def test_failed_job_contained_cancel_and_drain(tmp_path):
     checkpoint_dir FAILS alone (error recorded, service keeps going); a
     queued job cancels instantly; drain cancels the rest of the queue
     while the running job completes."""
-    igg.reset_health_counters()
+    _reset_health_counters()
     with MeshScheduler(policy="fifo",
                        flight_dir=str(tmp_path / "svc")) as sched:
         # fatal-by-design: poisoned from step 0, nothing to roll back to
@@ -421,7 +426,7 @@ def test_elastic_restart_isolated_and_neighbors_stay_warm(tmp_path):
     ref_a = _solo_reference(GRID_A, 12, 4)
 
     igg.reset_metrics()
-    igg.reset_health_counters()
+    _reset_health_counters()
     with MeshScheduler(policy="round_robin") as sched:
         sched.submit(_job("a", GRID_A, 12, 4))
         sched.submit(_job(
@@ -430,7 +435,7 @@ def test_elastic_restart_isolated_and_neighbors_stay_warm(tmp_path):
             faults=(igg.ProcessLoss(step=8, new_dims=(1, 2, 2)),)))
         sched.run()
         assert sched.status()["states"] == {"done": 2}
-        assert igg.health_counters()["elastic_restarts"] == 1
+        assert _health_counters()["elastic_restarts"] == 1
         # B ended on ITS restarted decomposition; A untouched on its own
         bgg = sched.job("b").gg
         assert tuple(int(d) for d in bgg.dims) == (1, 2, 2)

@@ -132,20 +132,27 @@ def test_registry_thread_safety():
     assert total == n_threads * n_iter
 
 
-def test_health_counters_shim_over_registry():
-    """PR-2's `health_counters` API keeps working as a thin shim over the
-    `igg_health_events_total` family; resetting it leaves other metric
-    families untouched (the documented deprecation path)."""
-    igg.record_health_event("chunks")
-    igg.record_health_event("chunks", 2)
-    igg.record_health_event("rollbacks")
-    assert igg.health_counters() == {"chunks": 3, "rollbacks": 1}
+def test_health_events_family_in_registry():
+    """The resilient runtime's health events are the
+    `igg_health_events_total{kind=...}` counter family — the registry is
+    the ONLY API (the PR-2 `health_counters`/`record_health_event`/
+    `reset_health_counters` shims were retired after two majors of
+    deprecation notice); a family reset leaves other metric families
+    untouched."""
+    from implicitglobalgrid_tpu.telemetry.hooks import record_health_event
+
+    assert not hasattr(igg, "health_counters")  # shim retired
+    record_health_event("chunks")
+    record_health_event("chunks", 2)
+    record_health_event("rollbacks")
     fam = igg.metrics_registry().get("igg_health_events_total")
     assert fam is not None and fam.value(kind="chunks") == 3
+    assert fam.value(kind="rollbacks") == 1
     other = igg.metrics_registry().counter("unrelated_total", "x")
     other.inc(5)
-    igg.reset_health_counters()
-    assert igg.health_counters() == {}
+    igg.metrics_registry().reset("igg_health_events_total")
+    fam = igg.metrics_registry().get("igg_health_events_total")
+    assert fam is None or not list(fam.samples())
     assert other.value() == 5
     snap = telemetry.prometheus_snapshot()
     assert "unrelated_total 5" in snap
