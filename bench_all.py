@@ -125,14 +125,30 @@ def main() -> None:
            _rate(cells, nta, t) / n_chips, "cell-updates/s/chip")
     igg.finalize_global_grid()
 
-    # --- halo coalescing A/B (2/4/8 fields) --------------------------------
-    # one packed ppermute pair per axis vs 2·N per-field permutes; the ratio
-    # trajectory starts recording with the coalescing PR. Config owned by
+    # --- halo coalescing A/B (2/4/8/16 fields) + pack attribution ----------
+    # one packed ppermute pair per axis vs 2·N per-field permutes, plus the
+    # pack/unpack-vs-permute attribution rows (`update_halo_pack_frac_*`)
+    # the perfdb gate watches for pack-bound regressions. Config owned by
     # `bench_halo.run_coalescing_ab` (shared with the standalone bench).
     import bench_halo
 
-    for row in bench_halo.run_coalescing_ab(dims3, cpu):
+    coalesce_rows = bench_halo.run_coalescing_ab(dims3, cpu)
+    for row in coalesce_rows:
         results.append(bench_util.emit(row))
+    # ISSUE 11 absolute gate: the 8-field coalesced exchange must beat the
+    # per-field baseline (>= 1x) — the canonical-wire-schema fix for the
+    # 0.75x regression. A direct gate like lint_ok: rc 1 under
+    # IGG_BENCH_STRICT=1, independent of the trailing-median perfdb check
+    # (which would tolerate a slow drift back below 1x).
+    speed8 = next(r["value"] for r in coalesce_rows
+                  if r["metric"] == "update_halo_coalesced_speedup_8fields")
+    coalesce8_ok = speed8 >= 1.0
+    results.append(bench_util.emit({
+        "metric": "coalesce_8field_restored_ok",
+        "value": 1.0 if coalesce8_ok else 0.0,
+        "unit": "bool (1 = 8-field coalesced exchange >= per-field)",
+        "speedup_8fields": speed8,
+    }))
 
     # --- quantized halo wire A/B (ISSUE 10) --------------------------------
     # static f32/int8 wire-byte ratio at 4 coalesced fields (payload +
@@ -275,7 +291,7 @@ def main() -> None:
     with open("BENCH_ALL.json", "w") as f:
         json.dump(results, f, indent=1)
     lint_failed = not ruff_missing and lint.returncode != 0
-    if (not gate["ok"] or lint_failed) \
+    if (not gate["ok"] or lint_failed or not coalesce8_ok) \
             and os.environ.get("IGG_BENCH_STRICT") == "1":
         sys.exit(1)
 

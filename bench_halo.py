@@ -29,15 +29,71 @@ import sys
 import bench_util
 
 
-def coalescing_ab_rows(nx: int, c1: int, field_counts=(2, 4, 8),
+def _pack_roundtrip_step(gg):
+    """A `local_update_halo`-shaped program with the ppermutes REPLACED BY
+    IDENTITY: per dim, the same canonical-schema pack -> unpack -> deliver
+    pipeline the coalesced exchange runs (`ops.wire`), minus the wire.
+    Timing it attributes the coalesced exchange's cost between pack/unpack
+    work and the collectives themselves — the attribution the perfdb gate
+    watches so a future PACK-bound regression (the 0.75x 8-field episode
+    this PR fixes) is caught as `update_halo_pack_frac_*` drift, not by
+    eyeballing BENCH_ALL."""
+    from jax import lax
+
+    from implicitglobalgrid_tpu.ops.halo import (
+        DEFAULT_DIMS_ORDER, _check_slab_fit, _dim_meta,
+    )
+    from implicitglobalgrid_tpu.ops.wire import slab_schema
+
+    def step(arrays):
+        arrays = list(arrays)
+        for dim in DEFAULT_DIMS_ORDER:
+            D, periodic, disp = _dim_meta(gg, dim)
+            if D == 1:
+                # mirror `_coalesce_groups`: self-neighbor axes are
+                # per-field local swaps with NO pack on the live path —
+                # packing them here would overstate pack_frac on meshes
+                # with singleton axes
+                continue
+            sends_r, sends_l, metas = [], [], []
+            for a in arrays:
+                hw = int(gg.halowidths[dim])
+                s = a.shape[dim]
+                ol_d = int(gg.overlaps[dim] + (s - gg.nxyz[dim]))
+                _check_slab_fit(s, dim, ol_d, hw)
+                sends_r.append(lax.slice_in_dim(a, s - ol_d, s - ol_d + hw,
+                                                axis=dim))
+                sends_l.append(lax.slice_in_dim(a, ol_d - hw, ol_d,
+                                                axis=dim))
+                metas.append((hw, s))
+            schema = slab_schema(dim, [x.shape for x in sends_r],
+                                 arrays[0].dtype)
+            recv_l = schema.unpack(schema.pack(sends_r))  # wire = identity
+            recv_r = schema.unpack(schema.pack(sends_l))
+            for k, a in enumerate(arrays):
+                hw, s = metas[k]
+                a = lax.dynamic_update_slice_in_dim(a, recv_l[k], 0,
+                                                    axis=dim)
+                arrays[k] = lax.dynamic_update_slice_in_dim(
+                    a, recv_r[k], s - hw, axis=dim)
+        return tuple(arrays)
+
+    return step
+
+
+def coalescing_ab_rows(nx: int, c1: int, field_counts=(2, 4, 8, 16),
                        dtype=None):
-    """A/B rows for the coalesced vs per-field multi-field exchange.
+    """A/B + attribution rows for the coalesced multi-field exchange.
 
     For each field count N, times the N-field `local_update_halo` hot loop
     with collective coalescing ON (one ppermute pair per axis) and OFF
-    (2·N permutes per axis) on the CURRENT grid, and returns one row per N
-    with ``value`` = per_field_seconds / coalesced_seconds (>1 means
-    coalescing wins; the latency-bound small-message regime it targets).
+    (2·N permutes per axis) on the CURRENT grid, plus the PACK-ROUNDTRIP
+    program (same schema pack/unpack/deliver, identity wire). Returns two
+    rows per N: the A/B ``update_halo_coalesced_speedup_{N}fields``
+    (value = per_field_s / coalesced_s, >1 means coalescing wins) and the
+    attribution ``update_halo_pack_frac_{N}fields`` (value = pack-roundtrip
+    share of the coalesced call — the perfdb gate flags it rising, i.e. a
+    pack-bound regression, independent of scheduler noise in the A/B).
     Caller owns grid init/finalize."""
     import numpy as np
 
@@ -45,15 +101,23 @@ def coalescing_ab_rows(nx: int, c1: int, field_counts=(2, 4, 8),
     from implicitglobalgrid_tpu.models.common import make_state_runner
 
     dtype = dtype or np.float32
+    gg = igg.global_grid()
     rows = []
     for n_fields in field_counts:
         fields = tuple(igg.ones_g((nx, nx, nx), dtype) * (i + 1)
                        for i in range(n_fields))
         secs = {}
-        for mode, co in (("coalesced", True), ("per_field", False)):
-            def step(s, co=co):
-                out = igg.local_update_halo(*s, coalesce=co)
-                return out if isinstance(out, tuple) else (out,)
+        pack_step = _pack_roundtrip_step(gg)
+        modes = (("coalesced", True), ("per_field", False),
+                 ("pack_roundtrip", None))
+        for mode, co in modes:
+            if co is None:
+                def step(s):
+                    return pack_step(s)
+            else:
+                def step(s, co=co):
+                    out = igg.local_update_halo(*s, coalesce=co)
+                    return out if isinstance(out, tuple) else (out,)
 
             def chunk(c):
                 run = make_state_runner(
@@ -61,13 +125,26 @@ def coalescing_ab_rows(nx: int, c1: int, field_counts=(2, 4, 8),
                     key=("bench_halo_ab", mode, n_fields, nx, str(dtype)))
                 igg.sync(run(*fields))
 
-            secs[mode] = bench_util.two_point(chunk, c1, 3 * c1)
+            # reps=4 (min-kept): the contended shared-core mesh injects
+            # scheduler spikes into individual windows; the min over four
+            # is the same contention-robust estimator `calibrate_machine`
+            # uses, and the A/B ratio is only meaningful between two
+            # uncontended draws
+            secs[mode] = bench_util.two_point(chunk, c1, 3 * c1, reps=4)
         rows.append({
             "metric": f"update_halo_coalesced_speedup_{n_fields}fields",
             "value": secs["per_field"] / secs["coalesced"],
             "unit": "x (per_field_s / coalesced_s)",
             "coalesced_s_per_call": secs["coalesced"],
             "per_field_s_per_call": secs["per_field"],
+        })
+        rows.append({
+            "metric": f"update_halo_pack_frac_{n_fields}fields",
+            "value": secs["pack_roundtrip"] / secs["coalesced"],
+            "unit": "frac (pack+unpack+deliver share of coalesced call)",
+            "pack_roundtrip_s_per_call": secs["pack_roundtrip"],
+            "permute_attributed_s_per_call": max(
+                0.0, secs["coalesced"] - secs["pack_roundtrip"]),
         })
     return rows
 
@@ -78,7 +155,10 @@ def run_coalescing_ab(dims, cpu: bool):
     and `bench_all.py` so the config stays in ONE place."""
     import implicitglobalgrid_tpu as igg
 
-    nx_ab, c_ab = (32, 4) if cpu else (256, 20)
+    # c_ab=8 (was 4): the A/B slope at 32^3 is dispatch-overhead-bound and
+    # the contended shared-core mesh swings short chunks by tens of
+    # percent — longer two-point chunks cut the draw-to-draw scatter
+    nx_ab, c_ab = (32, 8) if cpu else (256, 20)
     igg.init_global_grid(nx_ab, nx_ab, nx_ab, dimx=dims[0], dimy=dims[1],
                          dimz=dims[2], periodx=1, periody=1, periodz=1,
                          quiet=True)

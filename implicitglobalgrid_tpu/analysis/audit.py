@@ -157,22 +157,56 @@ def _model_program(model: str, impl: str, dtype):
         "diffusion2d, acoustic3d, stokes3d).")
 
 
+def _rounds_impl(model: str, impl: str, fields) -> str:
+    """The impl whose exchange ROUNDS the compiled program actually uses.
+
+    A Pallas request silently falls back to the XLA formulation when the
+    fused kernel's eligibility gate rejects the current grid/state
+    (`wave_exchange_modes`/`stokes_exchange_modes` — e.g. halowidth != 1
+    deep-halo grids), and the contract must follow the fallback: pricing
+    the fused rounds against an XLA-round program would fail a healthy
+    program — the false-failure class the retired ``contract_skipped``
+    exemption existed to prevent."""
+    if not str(impl).startswith("pallas"):
+        return impl
+    from ..parallel.topology import global_grid
+
+    gg = global_grid()
+    local = [tuple(int(s) // int(gg.dims[d]) if d < 3 else int(s)
+                   for d, s in enumerate(f.shape)) for f in fields]
+    if model == "acoustic3d":
+        from ..ops.pallas_wave import wave_exchange_modes
+
+        if wave_exchange_modes(gg, local) is None:
+            return "xla"
+    elif model == "stokes3d":
+        from ..ops.pallas_stokes import stokes_exchange_modes
+
+        if stokes_exchange_modes(gg, local) is None:
+            return "xla"
+    # diffusion's fused rounds equal the XLA rounds, so its fallbacks
+    # never change the contract
+    return impl
+
+
 def audit_model(model: str, *, impl: str = "xla", dtype=None,
                 wire_dtype=None, lints=None, crosscheck: bool = True,
                 optimized: bool = True) -> AuditReport:
     """Compile one model family's step program on the CURRENT grid and
     audit it against its plan-derived contract.
 
-    ``impl="xla"`` (default) compiles the path whose exchange structure
-    the static plan and `predict_step` price (coalesced
-    `local_update_halo` rounds); the fused Pallas kernels exchange
-    per-field in-kernel, so for any other ``impl`` the contract and
-    crosscheck are SKIPPED (lints still run; ``meta["contract_skipped"]``
-    records why) — their permute structure is pinned by the explicit
-    count audits in tests/test_hlo_audit.py instead. ``crosscheck``
-    additionally proves the perf oracle's priced ppermute pairs and wire
-    bytes equal the parsed program's (models outside `STEP_WORKLOADS`
-    skip it).
+    EVERY kernel tier gets a real contract: the fused Pallas kernels ride
+    the same canonical wire schema as the XLA path
+    (`ops.halo.exchange_recv_slabs_multi` — one ppermute pair per mesh
+    axis per round, byte-identical payload layout), so ``impl`` only
+    selects which exchange ROUNDS the contract prices
+    (`StepWorkload.groups_for`: e.g. the fused acoustic pass packs all
+    four fields into one round where the XLA leapfrog does two). The
+    pre-schema ``impl != 'xla'`` exemption (``contract_skipped``) is
+    gone — ``tools audit``'s exit-1 gate covers Pallas programs.
+    ``crosscheck`` additionally proves the perf oracle's priced ppermute
+    pairs and wire bytes equal the parsed program's (models outside
+    `STEP_WORKLOADS` skip it).
 
     ``wire_dtype`` is applied to BOTH sides: the compile (scoped
     ``IGG_HALO_WIRE_DTYPE`` — the runners resolve the wire format from
@@ -224,14 +258,16 @@ def audit_model(model: str, *, impl: str = "xla", dtype=None,
             os.environ["IGG_HALO_WIRE_DTYPE"] = saved_wire
     from ..telemetry.perfmodel import STEP_WORKLOADS
 
-    priced_path = impl == "xla"
-    if not priced_path:
-        meta["contract_skipped"] = (
-            "the static plan prices the impl='xla' exchange structure; "
-            "fused kernels exchange per-field in-kernel (lints only)")
+    rounds_impl = _rounds_impl(model, impl, fields)
+    if rounds_impl != impl:
+        meta["rounds_impl"] = (
+            f"{rounds_impl} (fused kernel ineligible on this grid/state; "
+            "the step fell back to the XLA formulation and the contract "
+            "follows it)")
     contract = None
-    if priced_path and model in STEP_WORKLOADS:
-        contract = model_contract(model, fields, wire_dtype=wire_dtype)
+    if model in STEP_WORKLOADS:
+        contract = model_contract(model, fields, wire_dtype=wire_dtype,
+                                  impl=rounds_impl)
     cfg = default_lint_config(
         state_dtypes={str(np.dtype(getattr(f, "dtype", "float32")))
                       for f in fields},
@@ -239,9 +275,9 @@ def audit_model(model: str, *, impl: str = "xla", dtype=None,
     rep = audit_program(ir, contract=contract, lints=lints,
                         lint_config=cfg, meta=meta)
     cc = None
-    if crosscheck and priced_path and model in STEP_WORKLOADS:
+    if crosscheck and model in STEP_WORKLOADS:
         cc = perfmodel_crosscheck(model, fields, ir,
-                                  wire_dtype=wire_dtype)
+                                  wire_dtype=wire_dtype, impl=rounds_impl)
     if cc is None:
         return rep
     return AuditReport(

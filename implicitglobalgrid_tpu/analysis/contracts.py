@@ -325,11 +325,14 @@ def exchange_contract(*fields, rounds=None, dims=None, coalesce=None,
 
 
 def model_contract(model, fields, *, dims=None, coalesce=None,
-                   wire_dtype=None,
+                   wire_dtype=None, impl: str = "xla",
                    guard_floats: int | None = None) -> CollectiveContract:
     """The step contract of a model family: exchange rounds from
-    `telemetry.STEP_WORKLOADS[model].exchange_groups`, priced over the
-    model's state ``fields`` (canonical state order)."""
+    `telemetry.STEP_WORKLOADS[model]`, priced over the model's state
+    ``fields`` (canonical state order). ``impl`` picks the kernel tier's
+    rounds (`StepWorkload.groups_for`): both tiers ride the canonical
+    wire schema, so a fused Pallas program gets the same byte-exact
+    contract as the XLA path — only the round grouping may differ."""
     from ..telemetry.perfmodel import STEP_WORKLOADS
 
     work = STEP_WORKLOADS.get(str(model))
@@ -338,9 +341,9 @@ def model_contract(model, fields, *, dims=None, coalesce=None,
             f"model_contract: unknown model {model!r} "
             f"(have {sorted(STEP_WORKLOADS)}).")
     return exchange_contract(
-        *fields, rounds=work.exchange_groups, dims=dims, coalesce=coalesce,
-        wire_dtype=wire_dtype, guard_floats=guard_floats,
-        meta={"model": str(model)})
+        *fields, rounds=work.groups_for(impl), dims=dims,
+        coalesce=coalesce, wire_dtype=wire_dtype, guard_floats=guard_floats,
+        meta={"model": str(model), "impl": str(impl)})
 
 
 def guard_contract(n_fields: int, reducer_floats: int = 0,
@@ -487,7 +490,8 @@ def check_contract(ir: ProgramIR, contract: CollectiveContract) -> list:
 # perfmodel cross-check
 
 def perfmodel_crosscheck(model, fields, ir: ProgramIR, *, profile=None,
-                         dims=None, coalesce=None, wire_dtype=None) -> dict:
+                         dims=None, coalesce=None, wire_dtype=None,
+                         impl: str = "xla") -> dict:
     """Prove `telemetry.predict_step`'s collective pricing against the
     compiled program: per mesh axis, the oracle's priced ppermute PAIRS
     and all-links wire bytes must equal what the parser measured in the
@@ -500,9 +504,9 @@ def perfmodel_crosscheck(model, fields, ir: ProgramIR, *, profile=None,
     check_initialized()
     gg = global_grid()
     pred = predict_step(model, fields, profile=profile, dims=dims,
-                        coalesce=coalesce, wire_dtype=wire_dtype)
+                        coalesce=coalesce, wire_dtype=wire_dtype, impl=impl)
     plan = _merged_plan(fields,
-                        _exchange_rounds(model, len(fields)),
+                        _exchange_rounds(model, len(fields), impl),
                         dims=dims, coalesce=coalesce, wire_dtype=wire_dtype)
     parsed = measure_axes(ir, axis_routes(gg))
     findings: list = []
@@ -549,16 +553,17 @@ def perfmodel_crosscheck(model, fields, ir: ProgramIR, *, profile=None,
             "routes matching no mesh axis — unpriceable by the model.",
             details=parsed[None]))
     return {"ok": not findings, "findings": findings, "axes": axes,
-            "model": str(model), "profile_source": pred["profile_source"]}
+            "model": str(model), "impl": str(impl),
+            "profile_source": pred["profile_source"]}
 
 
-def _exchange_rounds(model, n_fields: int):
+def _exchange_rounds(model, n_fields: int, impl: str = "xla"):
     from ..telemetry.perfmodel import STEP_WORKLOADS, StepWorkload
 
     if isinstance(model, StepWorkload):
-        return model.exchange_groups
+        return model.groups_for(impl)
     work = STEP_WORKLOADS.get(str(model))
     if work is None:
         raise InvalidArgumentError(
             f"unknown model {model!r} (have {sorted(STEP_WORKLOADS)}).")
-    return work.exchange_groups
+    return work.groups_for(impl)

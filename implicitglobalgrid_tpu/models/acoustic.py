@@ -112,21 +112,29 @@ def acoustic_step_local(state, p: AcousticParams, impl: str = "xla"):
         n = A.shape[d]
         return lax.slice_in_dim(A, 1, n, axis=d) - lax.slice_in_dim(A, 0, n - 1, axis=d)
 
-    Vx = Vx.at[1:-1, :, :].add(-p.dt / p.rho * dP(P, 0) / p.dx)
-    Vy = Vy.at[:, 1:-1, :].add(-p.dt / p.rho * dP(P, 1) / p.dy)
-    Vz = Vz.at[:, :, 1:-1].add(-p.dt / p.rho * dP(P, 2) / p.dz)
-    Vx, Vy, Vz = local_update_halo(Vx, Vy, Vz)
+    def v_update(vx, vy, vz, Pc):
+        vx = vx.at[1:-1, :, :].add(-p.dt / p.rho * dP(Pc, 0) / p.dx)
+        vy = vy.at[:, 1:-1, :].add(-p.dt / p.rho * dP(Pc, 1) / p.dy)
+        vz = vz.at[:, :, 1:-1].add(-p.dt / p.rho * dP(Pc, 2) / p.dz)
+        return vx, vy, vz
 
     def p_update(Pc, vx, vy, vz):
         divV = (dP(vx, 0) / p.dx + dP(vy, 1) / p.dy + dP(vz, 2) / p.dz)
         return Pc - p.dt * p.K * divV
 
     if p.overlap:
-        # radius-0 update from face-staggered fields: the shell of P computes
-        # first, its halo ppermutes overlap the interior divergence compute
-        # (hide_communication handles the staggered aux slicing).
+        # INTERIOR-FIRST rounds (models/common.interior_first_step): the
+        # V shell computes first, its ONE coalesced 3-field ppermute round
+        # overlaps the interior V update; then the P round likewise
+        # (radius-0 update from the face-staggered exchanged V fields).
+        from .common import interior_first_step
+
+        Vx, Vy, Vz = interior_first_step(v_update, (Vx, Vy, Vz), (P,),
+                                         radius=1)
         P = hide_communication(p_update, P, Vx, Vy, Vz, radius=0)
     else:
+        Vx, Vy, Vz = v_update(Vx, Vy, Vz, P)
+        Vx, Vy, Vz = local_update_halo(Vx, Vy, Vz)
         P = p_update(P, Vx, Vy, Vz)
         P = local_update_halo(P)
     return (P, Vx, Vy, Vz)

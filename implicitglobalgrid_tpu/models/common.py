@@ -13,7 +13,8 @@ from ..ops.fields import field_partition_spec
 from ..parallel.topology import check_initialized, global_grid
 
 __all__ = ["make_state_runner", "run_chunked", "default_check_vma",
-           "resolve_pallas_impl", "fresh_mask", "validate_deep_halo"]
+           "resolve_pallas_impl", "fresh_mask", "validate_deep_halo",
+           "interior_first_step"]
 
 _runner_cache: dict = {}
 
@@ -110,6 +111,29 @@ def validate_deep_halo(gg, ndim: int, k: int, depth_per_step: int = 1
                 f"comm_every={k} needs local size >= overlap + {need} on "
                 f"dim {d} (got n={n_d}, overlap={ol_d}): the send slabs "
                 "would leave the freshly-updated region.")
+
+
+def interior_first_step(update_fn, outs, aux=(), *, radius: int = 1,
+                        n_exchange: int | None = None, coalesce=None,
+                        wire_dtype=None):
+    """The INTERIOR-FIRST default shape of a step program (the chunk body
+    every model's ``overlap=True`` path routes through): boundary-shell
+    update -> ONE coalesced exchange round that depends only on the shell
+    -> interior update scheduled UNDER the collectives -> stitch. A thin,
+    named entry over `ops.overlap.hide_communication`'s multi-field form,
+    so model step functions declare the shape instead of re-deriving the
+    slab bookkeeping: ``outs`` is the tuple of updated fields (the first
+    ``n_exchange`` of them exchanged — the Stokes iteration updates 7
+    fields but wires 4), ``aux`` the read-only inputs, ``radius`` the
+    update's stencil radius. Semantically identical to
+    ``local_update_halo(*update_fn(*outs, *aux))``; the structural
+    independence of interior and permutes is HLO-audited
+    (tests/test_hlo_audit.py, `ProgramIR.closure`)."""
+    from ..ops.overlap import hide_communication
+
+    return hide_communication(update_fn, tuple(outs), *aux, radius=radius,
+                              n_exchange=n_exchange, coalesce=coalesce,
+                              wire_dtype=wire_dtype)
 
 
 def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,

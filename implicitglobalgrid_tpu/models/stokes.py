@@ -65,7 +65,17 @@ class StokesParams:
     bit-exact at every k, and ~25 cells/super-step-pair at
     lane-boundary positions carry the ulp). Immaterial for a PT solver
     converging to a tolerance; expected bit-exact on TPU's uniform
-    vector lanes (no epilogues), pending hardware validation."""
+    vector lanes (no epilogues), pending hardware validation.
+
+    ``overlap`` routes the XLA iteration through the INTERIOR-FIRST step
+    shape (`models/common.interior_first_step`): the 7 updated fields'
+    boundary shells compute first, the single coalesced 4-field
+    (Vx, Vy, Vz, Pn) ppermute round depends only on them, and the
+    interior update schedules under the collectives. Semantically
+    identical to the plain iteration (same caveat about CPU vector-loop
+    epilogue ulps as comm_every; asserted under the overlap-equivalence
+    tolerance in tests/test_overlap.py). XLA tier; the fused Pallas pass
+    structures its own communication and ignores it."""
     mu: float       # shear viscosity
     dt_v: float     # pseudo time step, momentum
     dt_p: float     # pseudo time step, pressure
@@ -74,10 +84,11 @@ class StokesParams:
     dy: float
     dz: float
     comm_every: int = 1
+    overlap: bool = False
 
 
 def init_stokes3d(*, mu=1.0, lx=10.0, ly=10.0, lz=10.0, rhog_mag=1.0,
-                  r_incl=1.0, dtype=None, comm_every=1):
+                  r_incl=1.0, dtype=None, comm_every=1, overlap=False):
     """State (P, Vx, Vy, Vz, dVx, dVy, dVz, rhog): zero initial flow, a
     buoyant sphere of radius ``r_incl`` at the domain center."""
     check_initialized()
@@ -108,7 +119,8 @@ def init_stokes3d(*, mu=1.0, lx=10.0, ly=10.0, lz=10.0, rhog_mag=1.0,
     dVz = zeros_g((nx, ny, nz + 1), dtype=dtype)
     state = (P, Vx, Vy, Vz, dVx, dVy, dVz, rhog)
     return state, StokesParams(mu=mu, dt_v=dt_v, dt_p=dt_p, damp=damp,
-                               dx=dx, dy=dy, dz=dz, comm_every=comm_every)
+                               dx=dx, dy=dy, dz=dz, comm_every=comm_every,
+                               overlap=overlap)
 
 
 def _d(A, d):
@@ -171,17 +183,38 @@ def stokes_step_local(state, p: StokesParams, impl: str = "xla"):
             return stokes_step_exchange_pallas(
                 state, gg, modes, p, interpret=impl == "pallas_interpret")
         # ineligible config: fall through to the XLA formulation
-    Pn, divV, Rx, Ry, Rz = _stokes_terms(state, p)
     ix = (slice(1, -1),) * 3
-    dVx_i = p.damp * dVx[ix] + Rx
-    dVy_i = p.damp * dVy[ix] + Ry
-    dVz_i = p.damp * dVz[ix] + Rz
-    dVx = dVx.at[ix].set(dVx_i)
-    dVy = dVy.at[ix].set(dVy_i)
-    dVz = dVz.at[ix].set(dVz_i)
-    Vx = Vx.at[ix].add(p.dt_v * dVx_i)
-    Vy = Vy.at[ix].add(p.dt_v * dVy_i)
-    Vz = Vz.at[ix].add(p.dt_v * dVz_i)
+
+    def pt_update(vx, vy, vz, Pc, dvx, dvy, dvz, rh):
+        """One PT update on (a slab of) the state — everything but the
+        exchange, returning the 7 updated fields in exchange-first order
+        (Vx, Vy, Vz, Pn first: the wired round of the interior-first
+        shape)."""
+        Pn, divV, Rx, Ry, Rz = _stokes_terms(
+            (Pc, vx, vy, vz, dvx, dvy, dvz, rh), p)
+        dvx_i = p.damp * dvx[ix] + Rx
+        dvy_i = p.damp * dvy[ix] + Ry
+        dvz_i = p.damp * dvz[ix] + Rz
+        return (vx.at[ix].add(p.dt_v * dvx_i),
+                vy.at[ix].add(p.dt_v * dvy_i),
+                vz.at[ix].add(p.dt_v * dvz_i),
+                Pn,
+                dvx.at[ix].set(dvx_i),
+                dvy.at[ix].set(dvy_i),
+                dvz.at[ix].set(dvz_i))
+
+    if p.overlap:
+        # interior-first: shells of all 7 updated fields, ONE coalesced
+        # (Vx, Vy, Vz, Pn) round depending only on them, interior under
+        # the collectives (models/common.interior_first_step)
+        from .common import interior_first_step
+
+        Vx, Vy, Vz, Pn, dVx, dVy, dVz = interior_first_step(
+            pt_update, (Vx, Vy, Vz, P, dVx, dVy, dVz), (rhog,),
+            radius=1, n_exchange=4)
+        return (Pn, Vx, Vy, Vz, dVx, dVy, dVz, rhog)
+    Vx, Vy, Vz, Pn, dVx, dVy, dVz = pt_update(Vx, Vy, Vz, P,
+                                              dVx, dVy, dVz, rhog)
     Vx, Vy, Vz, Pn = local_update_halo(Vx, Vy, Vz, Pn)
     return (Pn, Vx, Vy, Vz, dVx, dVy, dVz, rhog)
 

@@ -34,19 +34,26 @@ Exchange semantics reproduced exactly (index math from
 
 Collective coalescing (default ON; `IGG_HALO_COALESCE=0` or ``coalesce=False``
 reverts): when several fields of one dtype exchange along a ppermute axis,
-their send slabs are raveled and concatenated into ONE flat buffer per
-direction, so the axis costs a single ppermute pair REGARDLESS of field
-count — the latency-bound cost of N small collectives collapses into one
-message per link (the aggregation result of HiCCL, arXiv:2408.05962; the
-reference's analog is its multi-field pipelining note, `update_halo.jl:17`).
-Unpacking splits the flat receive buffer back into per-field slabs and
-delivers them via the multi-field Pallas kernel
+their send slabs pack into ONE buffer per direction on the CANONICAL WIRE
+SCHEMA (`ops.wire.WireSchema` — slab layout: concat along the exchange
+axis, slab shape preserved end-to-end; flat layout for staggered
+cross-shapes and quantized payloads), so the axis costs a single ppermute
+pair REGARDLESS of field count — the latency-bound cost of N small
+collectives collapses into one message per link (the aggregation result
+of HiCCL, arXiv:2408.05962; the reference's analog is its multi-field
+pipelining note, `update_halo.jl:17`). The SAME schema drives the fused
+Pallas kernels' exchange (`exchange_recv_slabs_multi`) and every
+byte-accounting layer (`halo_comm_plan` -> `predict_step` ->
+`exchange_contract`). Unpacking splits the receive buffer back into
+per-field slabs and delivers them via the multi-field Pallas kernel
 (`pallas_halo.halo_write_multi_pallas`, one launch per axis) or per-field
-`dynamic_update_slice`. Fields that cannot ride a packed exchange (lone
-dtype on an axis, non-participating dims) fall back to the per-field path;
-self-neighbor axes have no collective to coalesce and keep their local
-copies. Results are bit-identical to the per-field path
-(tests/test_update_halo.py) — packing is ravel/concat, no arithmetic.
+`dynamic_update_slice`; on TPU grids the pack side can likewise run as
+one fused launch (`pallas_halo.wire_pack_pallas`). Fields that cannot
+ride a packed exchange (lone dtype on an axis, non-participating dims)
+fall back to the per-field path; self-neighbor axes have no collective to
+coalesce and keep their local copies. Results are bit-identical to the
+per-field path (tests/test_update_halo.py) — packing is pure layout, no
+arithmetic.
 
 Wire precision (default OFF; `IGG_HALO_WIRE_DTYPE` / ``wire_dtype=``): float
 state optionally crosses the link narrowed (the EQuARX play,
@@ -76,13 +83,12 @@ from ..utils.exceptions import IncoherentArgumentError, InvalidArgumentError
 from .fields import (
     Field, check_fields, extract, field_partition_spec, wrap_field,
 )
-from .precision import (
-    SCALE_BYTES, decode_scales, dequantize_slab, encode_scales,
-    quant_slab_bytes, quantize_slab, resolve_wire_dtype, wire_format_for,
-)
+from .precision import resolve_wire_dtype, wire_format_for
+from .wire import schema_for_fields, slab_schema
 
 __all__ = ["update_halo", "local_update_halo", "free_update_halo_caches",
            "halo_may_use_pallas", "resolve_halo_coalesce", "halo_comm_plan",
+           "exchange_recv_slabs", "exchange_recv_slabs_multi",
            "DEFAULT_DIMS_ORDER"]
 
 # Reference default `dims=(3,1,2)` (1-based: z, x, y — update_halo.jl:29).
@@ -262,34 +268,46 @@ def _combined_plan(gg, shape, hws, dims_order):
     return modes
 
 
-def exchange_recv_slabs(gg, shape, hws, modes, get_slab):
-    """Masked, corner-patched RECEIVED slabs for every participating dim.
+def exchange_recv_slabs_multi(gg, shapes, hws, modes, getters, *,
+                              wire=None, coalesce=None):
+    """Masked, corner-patched RECEIVED slabs for every (field, dim) — the
+    shared slab pipeline of every fused kernel tier, on the CANONICAL wire
+    schema: per dim, all participating fields' send slabs pack into ONE
+    buffer per direction (`ops.wire.slab_schema`) and the axis costs a
+    single ppermute pair per (axis, dtype group) REGARDLESS of field count
+    — the same wire the XLA coalesced tier ships, which is what lets the
+    collective contracts and the quantized wire cover the Pallas programs
+    (`analysis.audit.audit_model(impl='pallas')`).
 
-    The slab pipeline of the combined one-pass exchange: per dim, in the
-    reference's write order (z, x, y — `update_halo.jl:29`), extract the
-    send slabs, patch them with earlier dims' received values (slab-level
-    corner propagation — exactly equivalent to the sequential per-dim
-    writes, where a later dim's send slab is extracted from the
-    post-earlier-write array), permute (or swap locally for self-neighbor
-    dims), and mask non-periodic boundaries with the patched current halos
+    Per dim, in the reference's write order (z, x, y — `update_halo.jl:29`):
+    extract each field's send slabs via its ``getters[f](dim, start,
+    size)`` hook (a plain slice for a standalone exchange, a freshly
+    COMPUTED slab when a model fuses its update with the exchange), patch
+    them with THAT field's earlier received values (slab-level corner
+    propagation — exactly equivalent to the sequential per-dim writes),
+    pack + permute (or swap locally for self-neighbor dims), unpack, and
+    mask non-periodic boundaries per field with the patched current halos
     (the PROC_NULL no-op, `init_global_grid.jl:103`).
 
-    ``get_slab(dim, start, size)`` returns the pre-exchange state values at
-    ``[start, start+size)`` along ``dim`` (full extent elsewhere) — a plain
-    slice for a standalone exchange, or a freshly COMPUTED slab when a model
-    fuses its update step with the exchange (`models/diffusion`).
-
-    Returns ``{dim: (recv_l, recv_r)}``.
+    ``shapes``/``modes``/``getters`` are dicts keyed by field name (the
+    dict order is the pack order); ``hws`` is the shared per-dim halowidth
+    tuple. ``wire`` is the RESOLVED wire policy (or None = exact);
+    ``coalesce=None`` resolves `resolve_halo_coalesce` (OFF restores one
+    pair per field). Returns ``{field: {dim: (recv_l, recv_r)}}``.
     """
     import jax.numpy as jnp
     from jax import lax
 
-    earlier = []  # [(dim, hw, (recv_l, recv_r))] in write order
+    if coalesce is None:
+        coalesce = resolve_halo_coalesce(None)
+    names = list(getters)
+    earlier = {f: [] for f in names}  # [(dim, hw, (recv_l, recv_r))]
+    recvs = {f: {} for f in names}
 
-    def patch(slab, d, start, size):
-        """Apply earlier dims' received halo values to a slab spanning
-        [start, start+size) along d (full extent along other dims)."""
-        for e, hw_e, (rl, rr) in earlier:
+    def patch(f, slab, d, start, size):
+        """Apply field ``f``'s earlier dims' received halo values to a slab
+        spanning [start, start+size) along d (full extent elsewhere)."""
+        for e, hw_e, (rl, rr) in earlier[f]:
             rl_s = lax.slice_in_dim(rl, start, start + size, axis=d)
             rr_s = lax.slice_in_dim(rr, start, start + size, axis=d)
             slab = lax.dynamic_update_slice_in_dim(slab, rl_s, 0, axis=e)
@@ -297,32 +315,65 @@ def exchange_recv_slabs(gg, shape, hws, modes, get_slab):
                 slab, rr_s, slab.shape[e] - hw_e, axis=e)
         return slab
 
-    recvs = {}
     for dim in DEFAULT_DIMS_ORDER:
-        if not modes[dim]:
+        parts = [f for f in names if modes[f][dim]]
+        if not parts:
             continue
         D, periodic, disp = _dim_meta(gg, dim)
         hw = int(hws[dim])
-        s = shape[dim]
-        ol_d = int(gg.overlaps[dim] + (shape[dim] - gg.nxyz[dim]))
-        send_r = patch(get_slab(dim, s - ol_d, hw), dim, s - ol_d, hw)
-        send_l = patch(get_slab(dim, ol_d - hw, hw), dim, ol_d - hw, hw)
-        if D == 1:  # periodic self-neighbor: local swap
-            recv_l, recv_r = send_r, send_l
-        else:
-            perm_p, perm_m = _perm_pairs(D, periodic, disp)
-            axis_name = AXIS_NAMES[dim]
-            recv_l = lax.ppermute(send_r, axis_name, perm_p)
-            recv_r = lax.ppermute(send_l, axis_name, perm_m)
-            if not periodic:  # PROC_NULL edges keep current (patched) halos
-                cur_l = patch(get_slab(dim, 0, hw), dim, 0, hw)
-                cur_r = patch(get_slab(dim, s - hw, hw), dim, s - hw, hw)
-                idx = lax.axis_index(axis_name)
-                recv_l = jnp.where(idx >= disp, recv_l, cur_l)
-                recv_r = jnp.where(idx < D - disp, recv_r, cur_r)
-        recvs[dim] = (recv_l, recv_r)
-        earlier.append((dim, hw, recvs[dim]))
+        sends = {}
+        for f in parts:
+            s = shapes[f][dim]
+            ol_d = int(gg.overlaps[dim] + (shapes[f][dim] - gg.nxyz[dim]))
+            send_r = patch(f, getters[f](dim, s - ol_d, hw), dim,
+                           s - ol_d, hw)
+            send_l = patch(f, getters[f](dim, ol_d - hw, hw), dim,
+                           ol_d - hw, hw)
+            sends[f] = (send_l, send_r)
+        if D == 1:  # periodic self-neighbor: local swap, no wire
+            for f in parts:
+                send_l, send_r = sends[f]
+                recvs[f][dim] = (send_r, send_l)
+                earlier[f].append((dim, hw, recvs[f][dim]))
+            continue
+        perm_p, perm_m = _perm_pairs(D, periodic, disp)
+        axis_name = AXIS_NAMES[dim]
+        by_dt = {}
+        for f in parts:
+            by_dt.setdefault(np.dtype(sends[f][0].dtype), []).append(f)
+        for dt, fs in by_dt.items():
+            fmt = wire_format_for(dt, wire, dim)
+            groups = [fs] if coalesce else [[f] for f in fs]
+            for g in groups:
+                schema = slab_schema(
+                    dim, [sends[f][0].shape for f in g], dt, fmt)
+                buf_r = schema.pack([sends[f][1] for f in g])
+                buf_l = schema.pack([sends[f][0] for f in g])
+                rls = schema.unpack(lax.ppermute(buf_r, axis_name, perm_p))
+                rrs = schema.unpack(lax.ppermute(buf_l, axis_name, perm_m))
+                if not periodic:  # PROC_NULL edges keep current halos EXACT
+                    idx = lax.axis_index(axis_name)
+                    for k, f in enumerate(g):
+                        s = shapes[f][dim]
+                        cur_l = patch(f, getters[f](dim, 0, hw), dim, 0, hw)
+                        cur_r = patch(f, getters[f](dim, s - hw, hw), dim,
+                                      s - hw, hw)
+                        rls[k] = jnp.where(idx >= disp, rls[k], cur_l)
+                        rrs[k] = jnp.where(idx < D - disp, rrs[k], cur_r)
+                for k, f in enumerate(g):
+                    recvs[f][dim] = (rls[k], rrs[k])
+        for f in parts:
+            earlier[f].append((dim, hw, recvs[f][dim]))
     return recvs
+
+
+def exchange_recv_slabs(gg, shape, hws, modes, get_slab, *, wire=None):
+    """Single-field form of `exchange_recv_slabs_multi` (the combined
+    one-pass exchange and the single-field fused kernels). Returns
+    ``{dim: (recv_l, recv_r)}``."""
+    return exchange_recv_slabs_multi(
+        gg, {"A": shape}, hws, {"A": modes}, {"A": get_slab},
+        wire=wire)["A"]
 
 
 def _combined_exchange(gg, a, hws, modes, interpret):
@@ -428,51 +479,40 @@ def _coalesced_pallas_mode(gg, dim, shapes, hws_dim):
     return bool(gg.use_pallas[dim]) and gg.device_type == "tpu", False
 
 
-def _quant_pack_group(parts, fmt):
-    """Quantize each field's raveled send slab against its own max-abs
-    scale and pack ONE int8 wire buffer: ``q_0 | q_1 | ... | scales``
-    (per-slab f32 scales bitcast to `SCALE_BYTES` int8 each, riding the
-    same buffer so the axis still costs a single ppermute pair)."""
-    import jax.numpy as jnp
+def _wire_pack_mode(gg, dim, shapes, hws_dim, schema):
+    """``(use_kernel, interpret)`` for the fused Pallas PACK of a
+    slab-layout wire buffer (one launch writes every field's send slab
+    into the packed payload — `pallas_halo.wire_pack_pallas`), or ``None``
+    for the XLA concat pack. Gated on the same conditions as the
+    multi-field unpack kernel (so `_build_exchange_fn`'s check_vma
+    accounting holds) plus `pallas_halo.wire_pack_supported`; quantized
+    payloads always pack through the flat XLA program (their scale-tail
+    arithmetic is elementwise work XLA already fuses well)."""
+    from .pallas_halo import wire_pack_supported
 
-    qs, scales = zip(*(quantize_slab(p, fmt) for p in parts))
-    return jnp.concatenate(list(qs) + [encode_scales(list(scales))])
-
-
-def _quant_unpack_group(buf, sizes, fmt, out_dtype):
-    """Inverse of `_quant_pack_group`: split the received int8 buffer back
-    into per-field quantized slabs + the scale tail, dequantize each slab
-    with ITS OWN received scale, and return the state-dtype flat buffer
-    (``sum(sizes)`` cells) the existing unpack pipeline consumes."""
-    import jax.numpy as jnp
-    from jax import lax
-
-    qsizes = [quant_slab_bytes(n, fmt) for n in sizes]
-    data = sum(qsizes)
-    scales = decode_scales(
-        lax.slice_in_dim(buf, data, data + SCALE_BYTES * len(sizes), axis=0),
-        len(sizes))
-    parts, off = [], 0
-    for k, (n, qb) in enumerate(zip(sizes, qsizes)):
-        parts.append(dequantize_slab(
-            lax.slice_in_dim(buf, off, off + qb, axis=0), scales[k], n,
-            fmt, out_dtype))
-        off += qb
-    return jnp.concatenate(parts)
+    if schema.layout != "slab" or schema.is_quant:
+        return None
+    use, interp = _coalesced_pallas_mode(gg, dim, shapes, hws_dim)
+    # budget with the STATE dtype: the kernel packs the raw slabs and any
+    # cast wire narrowing happens after (`WireSchema.pack`)
+    if not use or not wire_pack_supported(schema.shapes, dim,
+                                          schema.state_dtype):
+        return None
+    return True, interp
 
 
 def _exchange_dim_coalesced(gg, arrays, idxs, hws, dim, wire=None):
     """Exchange the halos of fields ``idxs`` (one dtype) along ``dim`` with
-    ONE ppermute pair: ravel + concatenate every field's send slab into a
-    flat buffer per direction, permute, split/reshape, deliver. Mutates
-    ``arrays``. With exact wire, values are bit-identical to the per-field
-    exchange — the pack stage is pure layout (and the PROC_NULL boundary
-    select runs on the packed buffer, elementwise-equal to the per-field
-    selects). Under a cast wire format the buffer crosses the link
-    narrowed; under a QUANT format (int8/int4) each field's slab is
+    ONE ppermute pair, on the canonical wire schema (`ops.wire`): pack
+    every field's send slab into one buffer per direction, permute,
+    unpack, deliver. Mutates ``arrays``. With exact wire, values are
+    bit-identical to the per-field exchange — the pack stage is pure
+    layout (slab layout: one concat along the exchange axis, no
+    ravel/reshape passes; the PROC_NULL boundary select runs per-field on
+    slab-sized operands). Under a cast wire format the buffer crosses the
+    link narrowed; under a QUANT format (int8/int4) each field's slab is
     quantized against its own max-abs scale and the f32 scales ride the
-    same buffer (`_quant_pack_group`) — still one ppermute pair, wire
-    bytes ~4-8x down."""
+    same flat buffer — still one ppermute pair, wire bytes ~4-8x down."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -480,8 +520,8 @@ def _exchange_dim_coalesced(gg, arrays, idxs, hws, dim, wire=None):
     axis_name = AXIS_NAMES[dim]
     perm_p, perm_m = _perm_pairs(D, periodic, disp)
 
-    metas = []  # (i, hw, s, slab_shape, flat_size)
-    parts_r, parts_l, cur_l_parts, cur_r_parts = [], [], [], []
+    metas = []  # (i, hw, s, slab_shape)
+    sends_r, sends_l, curs_l, curs_r = [], [], [], []
     for i in idxs:
         a = arrays[i]
         hw = int(hws[i][dim])
@@ -490,46 +530,29 @@ def _exchange_dim_coalesced(gg, arrays, idxs, hws, dim, wire=None):
         _check_slab_fit(s, dim, ol_d, hw)
         send_r = lax.slice_in_dim(a, s - ol_d, s - ol_d + hw, axis=dim)
         send_l = lax.slice_in_dim(a, ol_d - hw, ol_d, axis=dim)
-        metas.append((i, hw, s, send_r.shape, int(np.prod(send_r.shape))))
-        parts_r.append(send_r.reshape(-1))
-        parts_l.append(send_l.reshape(-1))
+        metas.append((i, hw, s, send_r.shape))
+        sends_r.append(send_r)
+        sends_l.append(send_l)
         if not periodic:  # exact-precision boundary halos (PROC_NULL no-op)
-            cur_l_parts.append(lax.slice_in_dim(a, 0, hw, axis=dim).reshape(-1))
-            cur_r_parts.append(lax.slice_in_dim(a, s - hw, s, axis=dim).reshape(-1))
+            curs_l.append(lax.slice_in_dim(a, 0, hw, axis=dim))
+            curs_r.append(lax.slice_in_dim(a, s - hw, s, axis=dim))
 
     state_dt = arrays[idxs[0]].dtype
     fmt = wire_format_for(state_dt, wire, dim)
-    sizes = [m[4] for m in metas]
-    if fmt is not None and fmt.is_quant:
-        flat_r = _quant_pack_group(parts_r, fmt)
-        flat_l = _quant_pack_group(parts_l, fmt)
-    else:
-        flat_r = jnp.concatenate(parts_r)
-        flat_l = jnp.concatenate(parts_l)
-        if fmt is not None:
-            flat_r = flat_r.astype(fmt.dtype)
-            flat_l = flat_l.astype(fmt.dtype)
-    recv_l = lax.ppermute(flat_r, axis_name, perm_p)
-    recv_r = lax.ppermute(flat_l, axis_name, perm_m)
-    if fmt is not None and fmt.is_quant:
-        recv_l = _quant_unpack_group(recv_l, sizes, fmt, state_dt)
-        recv_r = _quant_unpack_group(recv_r, sizes, fmt, state_dt)
-    elif fmt is not None:
-        recv_l = recv_l.astype(state_dt)
-        recv_r = recv_r.astype(state_dt)
-    if not periodic:
+    schema = slab_schema(dim, [m[3] for m in metas], state_dt, fmt)
+    pk = _wire_pack_mode(gg, dim, [arrays[i].shape for i in idxs],
+                         [m[1] for m in metas], schema)
+    recv_l = schema.unpack(lax.ppermute(
+        schema.pack(sends_r, pallas_mode=pk), axis_name, perm_p))
+    recv_r = schema.unpack(lax.ppermute(
+        schema.pack(sends_l, pallas_mode=pk), axis_name, perm_m))
+    if not periodic:  # per-field slab-sized selects (no cur-parts concat)
         idxv = lax.axis_index(axis_name)
-        recv_l = jnp.where(idxv >= disp, recv_l, jnp.concatenate(cur_l_parts))
-        recv_r = jnp.where(idxv < D - disp, recv_r,
-                           jnp.concatenate(cur_r_parts))
-
-    off = 0
-    slab_pairs = []  # aligned with metas
-    for (_, _, _, shp, size) in metas:
-        rl = lax.slice_in_dim(recv_l, off, off + size, axis=0).reshape(shp)
-        rr = lax.slice_in_dim(recv_r, off, off + size, axis=0).reshape(shp)
-        slab_pairs.append((rl, rr))
-        off += size
+        recv_l = [jnp.where(idxv >= disp, rl, cur)
+                  for rl, cur in zip(recv_l, curs_l)]
+        recv_r = [jnp.where(idxv < D - disp, rr, cur)
+                  for rr, cur in zip(recv_r, curs_r)]
+    slab_pairs = list(zip(recv_l, recv_r))  # aligned with metas
 
     use_multi, interp = _coalesced_pallas_mode(
         gg, dim, [arrays[i].shape for i in idxs], [m[1] for m in metas])
@@ -542,7 +565,7 @@ def _exchange_dim_coalesced(gg, arrays, idxs, hws, dim, wire=None):
         for i, o in zip(idxs, outs):
             arrays[i] = o
         return
-    for (i, hw, s, _, _), (rl, rr) in zip(metas, slab_pairs):
+    for (i, hw, s, _), (rl, rr) in zip(metas, slab_pairs):
         pw, interp = _pallas_write_mode(gg, dim, arrays[i].shape, hw)
         if pw:
             from .pallas_halo import halo_write_inplace
@@ -792,7 +815,7 @@ def _plan_from_sig(gg, sig, dims_order, coalesce, wire) -> dict:
     group) instead of one pair per field) and the wire policy (narrowed
     or quantized payloads — a quantized group's bytes count the int8/
     packed-int4 slabs PLUS the `SCALE_BYTES` f32 scale per slab, exactly
-    the buffer `_quant_pack_group` ships, so the plan stays exact to the
+    the buffer `WireSchema.pack` ships, so the plan stays exact to the
     byte). ``wire_bytes`` sums the payload over every source->dest
     link of the permute (all shards), both directions;
     ``local_copy_bytes`` counts self-neighbor slab swaps that never touch
@@ -833,14 +856,13 @@ def _plan_from_sig(gg, sig, dims_order, coalesce, wire) -> dict:
             in_group.update(g)
             f0 = fields[g[0]]
             fmt = wire_format_for(f0.dtype, wire, dim)
-            if fmt is not None and fmt.is_quant:
-                payload = sum(quant_slab_bytes(slab_cells(i, dim), fmt)
-                              for i in g) + SCALE_BYTES * len(g)
-                add_wire(dim, payload, fmt.name, npairs)
-            else:
-                wd = np.dtype(fmt.dtype if fmt is not None else f0.dtype)
-                payload = sum(slab_cells(i, dim) for i in g) * wd.itemsize
-                add_wire(dim, payload, str(wd), npairs)
+            # ONE pricing source for every packed payload: the canonical
+            # schema the live exchange ships (`ops.wire`) — exact to the
+            # byte incl. quantized slabs + their `SCALE_BYTES` scale tail
+            schema = schema_for_fields(
+                dim, [fields[i].shape for i in g],
+                [hws[i][dim] for i in g], f0.dtype, fmt)
+            add_wire(dim, schema.payload_bytes, schema.wire_key, npairs)
         for i, f in enumerate(fields):
             if i in in_group or not _dim_exchanges(gg, f.shape, hws[i], dim):
                 continue

@@ -1,4 +1,5 @@
-"""Communication/computation overlap — the `@hide_communication` analog.
+"""Communication/computation overlap — the `@hide_communication` analog,
+generalized to whole multi-field step programs.
 
 The reference ecosystem hides halo-exchange latency behind interior compute
 via ParallelStencil's `@hide_communication` (referenced from
@@ -7,18 +8,34 @@ overlap through per-field max-priority CUDA streams,
 `src/CUDAExt/update_halo.jl:157`). The TPU-native mechanism is data-flow:
 XLA's latency-hiding scheduler overlaps an async collective with any compute
 it does not depend on. `hide_communication` restructures one stencil step so
-that dependency structure exists:
+that dependency structure exists — the INTERIOR-FIRST step shape every
+model's chunk program can take (`models/common.py`):
 
 1. compute the updated BOUNDARY SHELL (slabs of width ``ol`` per exchanged
-   dim) from thin input slabs;
-2. run the halo exchange — its ppermutes depend only on the shell;
+   dim, ``ol + stagger`` for face-staggered outputs) from thin input slabs;
+2. run the halo exchange — ONE coalesced `local_update_halo` round of every
+   exchanged output, whose ppermutes depend only on the shell (and ride the
+   canonical wire schema, so the overlapped step keeps any reduced/quantized
+   wire policy bit-for-bit);
 3. compute the INTERIOR update — independent of (2), so XLA schedules it
    under the collectives;
 4. stitch interior + shell + received halos.
 
-Semantically identical to ``update(T)`` followed by ``update_halo`` (the
+Semantically identical to ``update(state)`` followed by ``update_halo`` (the
 shell cells see exactly the same neighborhoods); verified by tests against
-the plain path.
+the plain path for all three model families, and structurally audited at
+the HLO level (`ProgramIR.closure`: no SSA path between the interior update
+and any collective-permute — tests/test_hlo_audit.py, golden fixture
+tests/data/hlo/overlap_interior_first.stablehlo.txt).
+
+Multi-field form: ``T`` may be a tuple of output fields (face-staggered
+extents allowed, the reference's `shared.jl:107` convention) and
+``update_fn(*outs, *aux) -> tuple(outs)`` the whole step update;
+``n_exchange`` limits the exchange to the leading outputs (the Stokes PT
+iteration updates 7 fields but wires only 4). This is what turns the
+single-field helper into the default shape of a step program: the acoustic
+V-round (3 staggered fields, one coalesced round) and the full Stokes
+iteration route through the same four phases.
 """
 
 from __future__ import annotations
@@ -42,30 +59,40 @@ def _exchanged_dims(gg, a_ndim, dims_order):
 
 
 def hide_communication(update_fn, T, *aux, radius: int = 1, dims=None,
-                       halowidths=None, coalesce=None, wire_dtype=None):
-    """One overlapped step on a LOCAL block (use inside `shard_map`):
-    ``T_new = hide_communication(update_fn, T, Cp, ...)``.
+                       halowidths=None, coalesce=None, wire_dtype=None,
+                       n_exchange: int | None = None):
+    """One overlapped (interior-first) step on LOCAL blocks (use inside
+    `shard_map`): ``T_new = hide_communication(update_fn, T, Cp, ...)`` or,
+    multi-field, ``Vx, Vy, Vz = hide_communication(upd, (Vx, Vy, Vz), P)``.
 
-    ``coalesce``/``wire_dtype`` forward to the embedded exchange
-    (`local_update_halo`; defaults resolve from ``IGG_HALO_COALESCE`` /
-    ``IGG_HALO_WIRE_DTYPE``) — a wire-precision run keeps its reduced
-    wire format through the overlapped step.
+    ``T`` is one array or a tuple of output arrays; ``update_fn(*T_blocks,
+    *aux_blocks)`` returns the updated block(s) (same structure as ``T``)
+    and must be a pure local stencil of radius ``radius``: it may update
+    only cells whose full neighborhood lies inside the block, leaving edge
+    cells unchanged (the shape every reference-style stencil already has,
+    e.g. `diffusion3D_multicpu_novis.jl:42-47`). ``radius=0`` means every
+    cell's update is independent of its neighbors within the outputs (e.g.
+    a divergence update from face-staggered fields).
 
-    ``update_fn(T_block, *aux_blocks) -> T_block_updated`` must be a pure
-    local stencil of radius ``radius`` in ``T``: it may update only cells
-    whose full neighborhood lies inside the block, leaving edge cells
-    unchanged (the shape every reference-style stencil already has, e.g.
-    `diffusion3D_multicpu_novis.jl:42-47`). ``radius=0`` means every cell's
-    update is independent of its ``T`` neighbors (e.g. a divergence update
-    from face-staggered fields).
+    Output and ``aux`` arrays may be face-staggered — larger than the base
+    (elementwise-minimum) extent by 0 or 1 cells per dimension (the
+    reference's staggered-field convention, `shared.jl:107`): a slab of
+    cells ``[lo, hi)`` takes faces ``[lo, hi + stagger)``, and a staggered
+    output's shell/stitch regions grow by its stagger.
 
-    ``aux`` arrays are sliced along with ``T``; they may be face-staggered
-    — larger than ``T`` by 0 or 1 cells per dimension (the reference's
-    staggered-field convention, `shared.jl:107`): a slab of cells
-    ``[lo, hi)`` takes aux faces ``[lo, hi + stagger)``.
+    The exchange is ONE coalesced `local_update_halo` round of the first
+    ``n_exchange`` outputs (default: all of them) — one ppermute pair per
+    mesh axis for the whole round on the canonical wire schema.
+    ``coalesce``/``wire_dtype`` forward to it (defaults resolve from
+    ``IGG_HALO_COALESCE`` / ``IGG_HALO_WIRE_DTYPE``) — a wire-precision or
+    QUANTIZED run keeps its reduced wire format through the overlapped
+    step, bit-identically to the plain path (the send slabs are extracted
+    from the shell, whose values equal the plain update's, so per-slab
+    quantization scales cannot diverge). ``halowidths`` (single-field form
+    only) forwards per-field halowidths to the exchange.
 
-    Returns the updated, halo-exchanged block — bit-identical to
-    ``local_update_halo(update_fn(T, *aux))`` but with the exchange
+    Returns the updated, halo-exchanged block(s) — semantically identical
+    to ``local_update_halo(*update_fn(T, *aux))`` but with the exchange
     overlappable with the interior compute.
     """
     from jax import lax
@@ -75,19 +102,40 @@ def hide_communication(update_fn, T, *aux, radius: int = 1, dims=None,
     r = int(radius)
     if r < 0:
         raise InvalidArgumentError("radius must be >= 0.")
+    multi = isinstance(T, (tuple, list))
+    outs = tuple(T) if multi else (T,)
+    nex = len(outs) if n_exchange is None else int(n_exchange)
+    if not (1 <= nex <= len(outs)):
+        raise InvalidArgumentError(
+            f"n_exchange={n_exchange} must name 1..{len(outs)} leading "
+            "outputs.")
+    if multi and halowidths is not None:
+        raise InvalidArgumentError(
+            "halowidths is supported in the single-field form only (the "
+            "multi-field exchange uses the grid halowidths).")
     dims_order = _normalize_dims_order(dims)
-    ex_dims = _exchanged_dims(gg, T.ndim, dims_order)
-    staggers = []
-    for a in aux:
-        st = tuple(a.shape[d] - T.shape[d] for d in range(T.ndim))
+    ndim = outs[0].ndim
+    base = tuple(min(int(o.shape[d]) for o in outs) for d in range(ndim))
+    ex_dims = _exchanged_dims(gg, ndim, dims_order)
+
+    def stagger_of(a, what):
+        st = tuple(int(a.shape[d]) - base[d] for d in range(ndim))
         if any(s < 0 or s > 1 for s in st):
             raise InvalidArgumentError(
-                "hide_communication aux arrays must match T's shape or be "
-                "face-staggered (+1) per dimension."
-            )
-        staggers.append(st)
-    if not ex_dims:
-        return update_fn(T, *aux)
+                f"hide_communication {what} arrays must match the base "
+                "extent or be face-staggered (+1) per dimension.")
+        return st
+
+    out_stags = [stagger_of(o, "output") for o in outs]
+    aux_stags = [stagger_of(a, "aux") for a in aux]
+
+    def as_outs(res):
+        res = tuple(res) if isinstance(res, (tuple, list)) else (res,)
+        if len(res) != len(outs):
+            raise InvalidArgumentError(
+                f"update_fn returned {len(res)} outputs for "
+                f"{len(outs)} output fields.")
+        return res
 
     def region(arrays, stags, d, lo, hi):
         return tuple(
@@ -95,49 +143,67 @@ def hide_communication(update_fn, T, *aux, radius: int = 1, dims=None,
             for a, st in zip(arrays, stags)
         )
 
-    def exchange(U):
-        f = U if halowidths is None else {"A": U, "halowidths": halowidths}
-        return local_update_halo(f, dims=dims_order, coalesce=coalesce,
-                                 wire_dtype=wire_dtype)
+    def exchange(fields):
+        if halowidths is not None:
+            fields = [{"A": f, "halowidths": halowidths} for f in fields]
+        out = local_update_halo(*fields, dims=dims_order, coalesce=coalesce,
+                                wire_dtype=wire_dtype)
+        return list(out) if isinstance(out, tuple) else [out]
+
+    def finish(new_outs):
+        return tuple(new_outs) if multi else new_outs[0]
 
     def plain_fallback():
-        return exchange(update_fn(T, *aux))
+        new_outs = list(as_outs(update_fn(*outs, *aux)))
+        new_outs[:nex] = exchange(new_outs[:nex])
+        return finish(new_outs)
 
-    arrays = (T,) + aux
-    all_stags = [(0,) * T.ndim] + staggers
-    shell = T
+    arrays = outs + aux
+    all_stags = out_stags + aux_stags
+    if not ex_dims:
+        return finish(as_outs(update_fn(*outs, *aux)))
+
+    shells = list(outs)
     interior_lohi = {}
     for d in ex_dims:
-        s = T.shape[d]
+        s = base[d]
         ol_d = int(gg.overlaps[d])
         if s < 2 * (ol_d + r) + 1 or r > ol_d:
             # block too thin to split (or stencil radius exceeds the overlap,
             # so shell slices would go out of range): plain path
             return plain_fallback()
-        # left shell: input cells [0, ol+r) -> valid output [0, ol)
-        lsl = update_fn(*region(arrays, all_stags, d, 0, ol_d + r))
-        shell = lax.dynamic_update_slice_in_dim(
-            shell, lax.slice_in_dim(lsl, 0, ol_d, axis=d), 0, axis=d)
-        # right shell: input cells [s-ol-r, s) -> valid output last ol cells
-        rsl = update_fn(*region(arrays, all_stags, d, s - ol_d - r, s))
-        shell = lax.dynamic_update_slice_in_dim(
-            shell, lax.slice_in_dim(rsl, r, ol_d + r, axis=d), s - ol_d, axis=d)
+        # left shell: input cells [0, ol+r) -> valid output [0, ol+st)
+        lsl = as_outs(update_fn(*region(arrays, all_stags, d, 0, ol_d + r)))
+        # right shell: input cells [s-ol-r, s) -> valid output last ol+st
+        rsl = as_outs(update_fn(
+            *region(arrays, all_stags, d, s - ol_d - r, s)))
+        for f in range(len(outs)):
+            st = out_stags[f][d]
+            w = ol_d + st
+            shells[f] = lax.dynamic_update_slice_in_dim(
+                shells[f], lax.slice_in_dim(lsl[f], 0, w, axis=d), 0, axis=d)
+            shells[f] = lax.dynamic_update_slice_in_dim(
+                shells[f], lax.slice_in_dim(rsl[f], r, r + w, axis=d),
+                shells[f].shape[d] - w, axis=d)
         interior_lohi[d] = (ol_d, s - ol_d)
 
-    # (2) exchange: depends only on the shell slabs.
-    exchanged = exchange(shell)
+    # (2) exchange: ONE coalesced round, depends only on the shell slabs.
+    exchanged = exchange(shells[:nex]) + shells[nex:]
 
     # (3) interior: input = interior grown by r in exchanged dims.
     int_in, int_stags = arrays, all_stags
     for d in ex_dims:
         lo, hi = interior_lohi[d]
         int_in = region(int_in, int_stags, d, lo - r, hi + r)
-    int_out = update_fn(*int_in)
-    for d in reversed(ex_dims):
-        lo, hi = interior_lohi[d]
-        int_out = lax.slice_in_dim(int_out, r, r + (hi - lo), axis=d)
+    int_out = list(as_outs(update_fn(*int_in)))
+    for f in range(len(outs)):
+        for d in reversed(ex_dims):
+            lo, hi = interior_lohi[d]
+            st = out_stags[f][d]
+            int_out[f] = lax.slice_in_dim(
+                int_out[f], r + st, r + (hi - lo), axis=d)
 
-    # (4) stitch interior into the exchanged array. The barrier stops XLA
+    # (4) stitch interior into the exchanged arrays. The barrier stops XLA
     # from fusing the (permute-independent) interior compute INTO the
     # stitch — which depends on every permute and would serialize the
     # interior after the collectives, defeating the whole construction
@@ -146,8 +212,13 @@ def hide_communication(update_fn, T, *aux, radius: int = 1, dims=None,
     # fusion with no path to/from the permutes, which is exactly what the
     # latency-hiding scheduler needs to run it under them
     # (tests/test_hlo_audit.py::test_overlap_interior_independent_of_permutes).
-    exchanged, int_out = lax.optimization_barrier((exchanged, int_out))
-    starts = [0] * T.ndim
-    for d in ex_dims:
-        starts[d] = interior_lohi[d][0]
-    return lax.dynamic_update_slice(exchanged, int_out, tuple(starts))
+    exchanged, int_out = lax.optimization_barrier(
+        (tuple(exchanged), tuple(int_out)))
+    new_outs = []
+    for f in range(len(outs)):
+        starts = [0] * ndim
+        for d in ex_dims:
+            starts[d] = interior_lohi[d][0] + out_stags[f][d]
+        new_outs.append(lax.dynamic_update_slice(
+            exchanged[f], int_out[f], tuple(starts)))
+    return finish(new_outs)

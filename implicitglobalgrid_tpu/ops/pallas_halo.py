@@ -37,10 +37,82 @@ from functools import partial
 __all__ = ["halo_write_supported", "halo_write_inplace",
            "self_exchange_supported", "halo_self_exchange_pallas",
            "combined_write_supported", "halo_write_combined_pallas",
-           "multi_write_supported", "halo_write_multi_pallas"]
+           "multi_write_supported", "halo_write_multi_pallas",
+           "wire_pack_supported", "wire_pack_pallas"]
 
 _SUBLANE = 8
 _LANE = 128
+
+# single-step pack kernel: every slab + the packed buffer live in VMEM at
+# once — slabs are hw-thin planes/strips, so this bound is generous
+_WIRE_PACK_VMEM = 4 * 1024 * 1024
+
+
+def wire_pack_supported(slab_shapes, dim: int, pack_dtype) -> bool:
+    """Whether `wire_pack_pallas` can pack these send slabs along ``dim``:
+    3-D slabs, dims 0/1 only (dim 2 concat writes partial lane tiles —
+    the same DMA-efficiency cliff as `halo_write_supported`), uniform
+    cross extents (the slab layout's own precondition), and the whole
+    working set (slabs + packed buffer, double) under the VMEM budget.
+    ``pack_dtype`` is the dtype the kernel actually packs — the STATE
+    dtype (a narrower cast wire format converts AFTER the pack,
+    `WireSchema.pack`), so callers must not budget with the wire dtype."""
+    import numpy as np
+
+    shapes = [tuple(int(v) for v in s) for s in slab_shapes]
+    if dim not in (0, 1) or any(len(s) != 3 for s in shapes):
+        return False
+    cross = {tuple(v for d, v in enumerate(s) if d != dim) for s in shapes}
+    if len(cross) != 1:
+        return False
+    cells = sum(int(np.prod(s)) for s in shapes)
+    return 2 * cells * int(np.dtype(pack_dtype).itemsize) <= _WIRE_PACK_VMEM
+
+
+def wire_pack_pallas(slabs, *, dim: int, interpret: bool = False):
+    """Fused PACK of the slab-layout wire buffer: ONE kernel launch writes
+    every field's send slab into the packed payload (the concat along the
+    exchange axis of `ops.wire.WireSchema`) — K fields cost one launch
+    and one slab-sized write instead of the XLA concat's per-operand
+    copies. Gate with `wire_pack_supported`; bit-identical to the XLA
+    concat (pure layout, no arithmetic)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    widths = [int(s.shape[dim]) for s in slabs]
+    out_shape_dims = list(slabs[0].shape)
+    out_shape_dims[dim] = sum(widths)
+    try:
+        vma = jax.typeof(slabs[0]).vma
+        for s in slabs[1:]:
+            vma = vma | jax.typeof(s).vma
+        out_shape = jax.ShapeDtypeStruct(tuple(out_shape_dims),
+                                         slabs[0].dtype, vma=vma)
+    except (AttributeError, TypeError):
+        out_shape = jax.ShapeDtypeStruct(tuple(out_shape_dims),
+                                         slabs[0].dtype)
+
+    def kernel(*refs):
+        o_ref = refs[-1]
+        off = 0
+        for k, w in enumerate(widths):
+            if dim == 0:
+                o_ref[off:off + w] = refs[k][...]
+            else:
+                o_ref[:, off:off + w] = refs[k][...]
+            off += w
+
+    return pl.pallas_call(
+        kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(tuple(s.shape), lambda i, nd=s.ndim: (0,) * nd)
+                  for s in slabs],
+        out_specs=pl.BlockSpec(tuple(out_shape_dims),
+                               lambda i: (0,) * len(out_shape_dims)),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*slabs)
 
 
 def _ceil_to(x: int, m: int) -> int:

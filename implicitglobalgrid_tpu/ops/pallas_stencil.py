@@ -386,10 +386,13 @@ def diffusion3d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
     dtp = _const_dtype(T.dtype)
     consts = dict(lam=dtp(lam), dt=dtp(dt), dx=dtp(dx), dy=dtp(dy), dz=dtp(dz))
 
+    from .precision import resolve_wire_dtype
+
     recvs = exchange_recv_slabs(
         gg, T.shape, (1, 1, 1), modes,
         lambda dim, start, size: _xla_update_slab(T, Cp, dim, start, size,
-                                                  consts))
+                                                  consts),
+        wire=resolve_wire_dtype(None))
 
     P = mp_planes(T, interpret=interpret)
     mp = P is not None
@@ -1117,10 +1120,13 @@ def diffusion2d_step_exchange_pallas(T, Cp, gg, modes, *, lam, dt, dx, dy,
     dtp = _const_dtype(T.dtype)
     consts = dict(lam=dtp(lam), dt=dtp(dt), dx=dtp(dx), dy=dtp(dy))
 
+    from .precision import resolve_wire_dtype
+
     recvs = exchange_recv_slabs(
         gg, T.shape, (1, 1), modes,
         lambda dim, start, size: _xla_update_slab(T, Cp, dim, start, size,
-                                                  consts))
+                                                  consts),
+        wire=resolve_wire_dtype(None))
 
     blk = (R, ny)
     operands = [T, Cp]

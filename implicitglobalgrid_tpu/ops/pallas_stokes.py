@@ -16,10 +16,12 @@ send slabs are computed from local thin windows. The slab computes reuse
 3-cell (cell-target) or 2-cell (face-target) window around the slab — whose
 central values are exactly the full-step values (the stencil radius fits
 the window; `_inner`'s trims align the mini interior with the target).
-Received slabs flow through the shared `exchange_recv_slabs` pipeline
-(ppermutes / local swaps / PROC_NULL masking / per-field corner patching),
-and are delivered in the kernel's output pass in the reference's z, x, y
-order. Vx's extra face plane (and dVx's, which is not exchanged) is
+Received slabs flow through the shared PACKED pipeline
+(`exchange_recv_slabs_multi`: the 4 exchanged fields' slabs ride ONE
+ppermute pair per mesh axis on the canonical wire schema — wire policy
+included — plus local swaps / PROC_NULL masking / per-field corner
+patching), and are delivered in the kernel's output pass in the
+reference's z, x, y order. Vx's extra face plane (and dVx's, which is not exchanged) is
 written post-kernel like the acoustic kernel's.
 
 Requires the full-size face-aligned dV state of `init_stokes3d` and
@@ -288,7 +290,8 @@ def stokes_step_exchange_pallas(state, gg, modes, p, *, interpret=False):
     from jax import lax
     from jax.experimental import pallas as pl
 
-    from .halo import exchange_recv_slabs
+    from .halo import exchange_recv_slabs_multi
+    from .precision import resolve_wire_dtype
 
     P, Vx, Vy, Vz, dVx, dVy, dVz, rhog = state
     nx, ny, nz = P.shape
@@ -312,9 +315,10 @@ def stokes_step_exchange_pallas(state, gg, modes, p, *, interpret=False):
         # source planes (see pallas_wave / pallas_common.self_deliver)
         recvs, self_ols = self_recvs_and_ols(gg, shapes, modes, getters)
     else:
-        recvs = {f: exchange_recv_slabs(gg, shapes[f], hws, modes[f],
-                                        getters[f])
-                 for f in ("Vx", "Vy", "Vz", "P")}
+        # the shared packed pipeline: ONE ppermute pair per mesh axis for
+        # the 4 exchanged fields, on the canonical wire schema + policy
+        recvs = exchange_recv_slabs_multi(gg, shapes, hws, modes, getters,
+                                          wire=resolve_wire_dtype(None))
 
     def spec(shape, index_map):
         return pl.BlockSpec(shape, index_map)

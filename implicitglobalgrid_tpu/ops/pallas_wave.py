@@ -15,8 +15,11 @@ Why one pass is semantically sound (halowidth-1 fields):
   received values needed.
 - Velocity SEND slabs sit >= 1 face inside the block, so they are computed
   from local P alone (`_xla_update_slab`-style thin-slab computes); the
-  received slabs come from the shared `exchange_recv_slabs` pipeline
-  (ppermutes / local swaps / PROC_NULL masking / corner patching).
+  received slabs come from the shared PACKED pipeline
+  (`exchange_recv_slabs_multi`: all four fields' slabs ride ONE ppermute
+  pair per mesh axis on the canonical wire schema — the same wire, and
+  the same `IGG_HALO_WIRE_DTYPE` policy, the XLA tier ships — plus local
+  swaps / PROC_NULL masking / corner patching).
 - The pressure update needs post-exchange V faces ONLY at cells that are
   themselves P halo cells: every surviving cell of every P send slab is
   interior in the cross dimensions (its cross-dim edge cells are either
@@ -388,7 +391,8 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
     from jax import lax
     from jax.experimental import pallas as pl
 
-    from .halo import exchange_recv_slabs
+    from .halo import exchange_recv_slabs_multi
+    from .precision import resolve_wire_dtype
 
     P, Vx, Vy, Vz = state
     nx, ny, nz = P.shape
@@ -419,9 +423,11 @@ def acoustic_step_exchange_pallas(state, gg, modes, *, rho, K, dt,
     if all_self:
         recvs, self_ols = self_recvs_and_ols(gg, shapes, modes, getters)
     else:
-        recvs = {f: exchange_recv_slabs(gg, shapes[f], hws, modes[f],
-                                        getters[f])
-                 for f in ("Vx", "Vy", "Vz", "P")}
+        # the shared packed pipeline: ONE ppermute pair per mesh axis for
+        # all four fields (the same canonical wire schema — and the same
+        # wire POLICY — the XLA tier ships; `exchange_recv_slabs_multi`)
+        recvs = exchange_recv_slabs_multi(gg, shapes, hws, modes, getters,
+                                          wire=resolve_wire_dtype(None))
 
     def spec(shape, index_map):
         return pl.BlockSpec(shape, index_map)

@@ -1,0 +1,242 @@
+"""Canonical wire schema — ONE packing program for every kernel tier.
+
+Before this module, the repo had three independent spellings of "how halo
+payloads are laid out on the wire": the XLA coalesced exchange's
+ravel+concat pack (`ops.halo._exchange_dim_coalesced`), the quantized
+pack/unpack pair (`_quant_pack_group`/`_quant_unpack_group`), and the
+Pallas fused kernels' per-field in-kernel permutes (`pallas_wave`,
+`pallas_stokes` — which therefore escaped PR 7's collective contracts and
+PR 9's quantized wire entirely). TEMPI (arXiv:2012.14363) names the fix:
+derive ONE canonical packing program from the datatype/slab spec and reuse
+it everywhere.
+
+`WireSchema` is that program. Built from the slab signature alone — slab
+shapes x state dtype x exchange axis x `WireFormat` — it fixes:
+
+- the **layout**: ``"slab"`` packs by concatenating the send slabs ALONG
+  the exchange axis (slab shape preserved end-to-end: no ravel pass on
+  pack, no reshape pass on unpack — the select/concat traffic that put the
+  8-field coalesced exchange BELOW the per-field baseline on the CPU mesh,
+  BENCH_ALL.json 0.75x); ``"flat"`` ravels and concatenates (required
+  whenever the slab cross-shapes differ — staggered multi-field packs — or
+  the payload is quantized, whose per-slab f32 scales ride a byte tail
+  only a flat buffer has);
+- the **wire dtype**: the state dtype, a narrower float cast, or int8
+  bytes (bit-packed int4 included) per `precision.wire_format_for`;
+- the **byte accounting**: ``payload_bytes`` is exact to the byte and is
+  the single number `ops.halo._plan_from_sig`, `halo_comm_plan`,
+  `telemetry.predict_step`, and `analysis.contracts` all price — the plan,
+  the oracle, and the compiled-program audit can no longer drift apart on
+  layout.
+
+`pack(slabs)`/`unpack(buffer)` are the only two entry points; both tiers
+call them: the XLA coalesced path packs Python-side slices, the Pallas
+fused kernels pack the thin-slab mini-computes of
+`ops.halo.exchange_recv_slabs_multi` — one ppermute pair per mesh axis per
+round for EVERY tier, which is what lets `analysis.audit.audit_model`
+derive real contracts for ``impl='pallas'`` programs.
+
+On TPU grids the ``"slab"`` pack can additionally run as one fused Pallas
+kernel (`pallas_halo.wire_pack_pallas` — all fields' slabs written into
+the packed buffer in a single launch); `schema.pack` gates that on
+`pallas_halo.wire_pack_supported` and falls back to the XLA concat
+everywhere else (the CPU mesh measures the XLA slab layout directly).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..utils.exceptions import InvalidArgumentError
+from .precision import (
+    SCALE_BYTES, decode_scales, dequantize_slab, encode_scales,
+    quant_slab_bytes, quantize_slab,
+)
+
+__all__ = ["WireSchema", "slab_schema", "schema_for_fields"]
+
+
+@dataclass(frozen=True)
+class WireSchema:
+    """One direction's packing program for a group of same-dtype slabs.
+
+    ``shapes`` are the send-slab shapes in pack order; ``dim`` the
+    exchange array axis; ``fmt`` the resolved `WireFormat` (``None`` =
+    exact wire); ``layout`` is ``"slab"`` or ``"flat"`` (see module
+    docstring). Frozen and hashable — derived once per exchange signature
+    and shared by the pack, the unpack, and every byte-accounting layer.
+    """
+
+    dim: int
+    shapes: tuple          # per-slab shapes, pack order
+    state_dtype: str       # numpy dtype name
+    fmt: object = None     # WireFormat | None
+    layout: str = "slab"
+
+    # -- derived geometry ---------------------------------------------------
+
+    @property
+    def n_slabs(self) -> int:
+        return len(self.shapes)
+
+    @property
+    def cells(self) -> tuple:
+        """Per-slab element counts, pack order."""
+        return tuple(int(np.prod(s)) for s in self.shapes)
+
+    @property
+    def is_quant(self) -> bool:
+        return self.fmt is not None and self.fmt.is_quant
+
+    @property
+    def wire_dtype(self):
+        """The numpy dtype the packed buffer crosses the link in."""
+        if self.fmt is not None:
+            return np.dtype(self.fmt.dtype)
+        return np.dtype(self.state_dtype)
+
+    @property
+    def payload_bytes(self) -> int:
+        """EXACT bytes of one direction's packed payload — the number every
+        wire-reasoning layer prices (`halo_comm_plan` by-dtype rows,
+        `predict_step` per-axis pricing, `exchange_contract` wire-byte
+        equality against the compiled program)."""
+        if self.is_quant:
+            return (sum(quant_slab_bytes(c, self.fmt) for c in self.cells)
+                    + SCALE_BYTES * self.n_slabs)
+        return sum(self.cells) * int(self.wire_dtype.itemsize)
+
+    @property
+    def wire_key(self) -> str:
+        """The `halo_comm_plan` ``by_dtype`` key of this payload (the
+        format name for quantized wire, the dtype name otherwise)."""
+        return self.fmt.name if self.is_quant else str(self.wire_dtype)
+
+    # -- the packing program ------------------------------------------------
+
+    def pack(self, slabs, *, pallas_mode=None):
+        """Pack the per-field send slabs into ONE wire buffer.
+
+        ``slabs`` are arrays of exactly ``self.shapes`` (pack order).
+        ``pallas_mode`` is ``None`` (XLA pack) or ``(use_kernel,
+        interpret)`` from `pallas_halo.wire_pack_mode` — the fused
+        single-launch pack of the slab layout on TPU grids."""
+        import jax.numpy as jnp
+
+        self._check(slabs)
+        if self.is_quant:
+            qs, scales = zip(*(quantize_slab(s.reshape(-1), self.fmt)
+                               for s in slabs))
+            return jnp.concatenate(list(qs) + [encode_scales(list(scales))])
+        if self.layout == "flat":
+            buf = jnp.concatenate([s.reshape(-1) for s in slabs])
+        elif pallas_mode is not None and pallas_mode[0]:
+            from .pallas_halo import wire_pack_pallas
+
+            buf = wire_pack_pallas(list(slabs), dim=self.dim,
+                                   interpret=pallas_mode[1])
+        elif len(slabs) == 1:
+            buf = slabs[0]
+        else:
+            buf = jnp.concatenate(list(slabs), axis=self.dim)
+        if self.fmt is not None:
+            buf = buf.astype(self.wire_dtype)
+        return buf
+
+    def unpack(self, buf):
+        """Inverse of `pack`: the received wire buffer back into per-field
+        slabs of ``self.shapes`` in the state dtype (dequantized /
+        upcast — boundary masking and delivery stay with the caller)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        out_dt = np.dtype(self.state_dtype)
+        if self.is_quant:
+            cells = self.cells
+            qsizes = [quant_slab_bytes(c, self.fmt) for c in cells]
+            data = sum(qsizes)
+            scales = decode_scales(
+                lax.slice_in_dim(buf, data,
+                                 data + SCALE_BYTES * self.n_slabs, axis=0),
+                self.n_slabs)
+            out, off = [], 0
+            for k, (c, qb) in enumerate(zip(cells, qsizes)):
+                flat = dequantize_slab(
+                    lax.slice_in_dim(buf, off, off + qb, axis=0),
+                    scales[k], c, self.fmt, out_dt)
+                out.append(flat.reshape(self.shapes[k]))
+                off += qb
+            return out
+        if self.fmt is not None:
+            buf = buf.astype(out_dt)
+        out = []
+        if self.layout == "flat":
+            off = 0
+            for shp, c in zip(self.shapes, self.cells):
+                out.append(lax.slice_in_dim(buf, off, off + c,
+                                            axis=0).reshape(shp))
+                off += c
+            return out
+        if self.n_slabs == 1:
+            return [buf]
+        off = 0
+        for shp in self.shapes:
+            w = int(shp[self.dim])
+            out.append(lax.slice_in_dim(buf, off, off + w, axis=self.dim))
+            off += w
+        return out
+
+    def _check(self, slabs) -> None:
+        if len(slabs) != self.n_slabs:
+            raise InvalidArgumentError(
+                f"WireSchema.pack: {len(slabs)} slabs for a "
+                f"{self.n_slabs}-slab schema.")
+        for s, shp in zip(slabs, self.shapes):
+            if tuple(int(v) for v in s.shape) != shp:
+                raise InvalidArgumentError(
+                    f"WireSchema.pack: slab shape {tuple(s.shape)} does "
+                    f"not match the schema's {shp}.")
+
+
+def _slab_layout_ok(dim: int, shapes) -> bool:
+    """Whether the slab (concat-along-axis) layout applies: every slab must
+    share the cross-axis extents (staggered multi-field packs differ there
+    and take the flat layout)."""
+    cross = None
+    for shp in shapes:
+        c = tuple(v for d, v in enumerate(shp) if d != dim)
+        if cross is None:
+            cross = c
+        elif c != cross:
+            return False
+    return True
+
+
+def slab_schema(dim: int, shapes, state_dtype, fmt=None) -> WireSchema:
+    """Derive the canonical schema for one (axis, dtype group) from the
+    slab signature alone. ``fmt`` is the resolved `WireFormat` for this
+    axis (`precision.wire_format_for`), or ``None`` for exact wire."""
+    shapes = tuple(tuple(int(v) for v in s) for s in shapes)
+    if not shapes:
+        raise InvalidArgumentError("slab_schema needs at least one slab.")
+    quant = fmt is not None and fmt.is_quant
+    layout = "flat" if quant or not _slab_layout_ok(dim, shapes) else "slab"
+    return WireSchema(dim=int(dim), shapes=shapes,
+                      state_dtype=str(np.dtype(state_dtype)), fmt=fmt,
+                      layout=layout)
+
+
+def schema_for_fields(dim: int, shapes, hws, state_dtype,
+                      fmt=None) -> WireSchema:
+    """`slab_schema` from FIELD shapes (local blocks) instead of slab
+    shapes: the send slab of a field along ``dim`` is its cross extents x
+    the halowidth. The one geometry rule (`ops.halo`: slab width = hw)
+    lives here so the static plan and the live pack can never disagree."""
+    slab_shapes = []
+    for shp, hw in zip(shapes, hws):
+        s = list(int(v) for v in shp)
+        s[dim] = int(hw)
+        slab_shapes.append(tuple(s))
+    return slab_schema(dim, slab_shapes, state_dtype, fmt)

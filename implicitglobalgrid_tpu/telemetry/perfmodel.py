@@ -168,13 +168,30 @@ class StepWorkload:
     `local_update_halo` round (fields in one round coalesce into one
     ppermute pair per axis; separate rounds pay separate launches) —
     diffusion exchanges only T, the acoustic leapfrog does a V round
-    then a P round. Deliberate single-digit precision throughout: the
+    then a P round. ``fused_exchange_groups`` are the rounds the Pallas
+    FUSED pass issues when they differ (the acoustic kernel exchanges all
+    four fields in ONE packed round where the XLA leapfrog does two);
+    ``None`` means the tiers share the same rounds. Since the fused tier
+    rides the canonical wire schema (`ops.wire`), these rounds price —
+    and contract-audit — Pallas programs exactly like XLA ones
+    (`groups_for`). Deliberate single-digit precision throughout: the
     model's job is picking the right regime and being within 2x, not
     reproducing a cycle simulator."""
 
     flops_per_cell: float
     hbm_passes: float
     exchange_groups: tuple = ((0,),)
+    fused_exchange_groups: tuple | None = None
+
+    def groups_for(self, impl: str = "xla") -> tuple:
+        """The exchange rounds of one kernel tier: ``impl="xla"`` (or any
+        non-Pallas spelling) prices the XLA step's rounds; a Pallas impl
+        prices the fused pass's (same rounds unless the workload declares
+        ``fused_exchange_groups``)."""
+        if str(impl).startswith("pallas") \
+                and self.fused_exchange_groups is not None:
+            return self.fused_exchange_groups
+        return self.exchange_groups
 
 
 # One entry per model family in `models/` (validated against the measured
@@ -187,9 +204,11 @@ STEP_WORKLOADS = {
     "diffusion2d": StepWorkload(flops_per_cell=14.0, hbm_passes=4.0,
                                 exchange_groups=((0,),)),
     # state (P, Vx, Vy, Vz): the leapfrog exchanges the 3 V fields in one
-    # coalesced round, then P in its own round (overlapped when enabled)
+    # coalesced round, then P in its own round (overlapped when enabled);
+    # the FUSED Pallas pass packs all four fields into ONE round
     "acoustic3d": StepWorkload(flops_per_cell=20.0, hbm_passes=8.0,
-                               exchange_groups=((1, 2, 3), (0,))),
+                               exchange_groups=((1, 2, 3), (0,)),
+                               fused_exchange_groups=((0, 1, 2, 3),)),
     # state (P, Vx, Vy, Vz, dVx, dVy, dVz, rhog): one coalesced round of
     # the 4 wave fields per PT iteration (models/stokes.py:185)
     "stokes3d": StepWorkload(flops_per_cell=60.0, hbm_passes=16.0,
@@ -211,7 +230,8 @@ def _axis_npairs(gg, dim: int) -> int:
 
 def predict_step(model, fields, *, profile: MachineProfile | None = None,
                  comm_every: int = 1, overlap: bool = False,
-                 dims=None, coalesce=None, wire_dtype=None) -> dict:
+                 dims=None, coalesce=None, wire_dtype=None,
+                 impl: str = "xla") -> dict:
     """Predict one step's cost on the CURRENT grid for stacked ``fields``.
 
     ``model`` is a `STEP_WORKLOADS` key or a `StepWorkload`; ``fields``
@@ -224,8 +244,16 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
     the deep-halo cadence: the exchange (whose k-wide slabs the fields'
     halowidths already describe) is charged once per k steps.
     ``overlap`` credits communication that hides behind interior compute
-    (`hide_communication` / the latency-hiding scheduler): exposed comm
-    = max(0, comm - compute) instead of comm.
+    (the interior-first step shape of `hide_communication` / the
+    latency-hiding scheduler). The credit is priced from the slab
+    geometry of the wire schema: only the INTERIOR fraction of the
+    compute can hide the wire — the boundary-shell update (the overlap
+    bands each exchanging dim peels off) must complete BEFORE the
+    collectives launch, so exposed comm = max(0, comm - compute *
+    interior_frac) and the returned record carries ``interior_frac``.
+    ``impl`` selects the kernel tier's exchange rounds
+    (`StepWorkload.groups_for` — the fused Pallas pass may group rounds
+    differently, e.g. acoustic's one packed 4-field round).
 
     Returns a record with per-step seconds and the roofline verdict::
 
@@ -264,7 +292,7 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
     # into per-axis totals
     fields = tuple(fields)
     plan = {"axes": {}, "local_copy_bytes": 0}
-    for group in work.exchange_groups:
+    for group in work.groups_for(impl):
         if any(i >= len(fields) for i in group):
             raise InvalidArgumentError(
                 f"predict_step: model {model_name!r} expects at least "
@@ -312,7 +340,22 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
     local_copy_s = (2.0 * plan["local_copy_bytes"]
                     / (profile.membw_GBps * 1e9)) / k
     comm_s = lat_total + wire_total + local_copy_s
-    exposed = max(0.0, comm_s - compute_s) if overlap else comm_s
+    # interior-first overlap credit, priced from the slab geometry: each
+    # exchanging dim peels a 2*ol-deep boundary shell off the local block
+    # that must compute BEFORE the collectives launch — only the interior
+    # remainder schedules under them
+    interior_frac = 1.0
+    if overlap:
+        interior = 1
+        for d in range(min(3, len(shape0))):
+            n_d = shape0[d] // int(gg.dims[d])
+            D = int(gg.dims[d])
+            if D > 1 or bool(gg.periods[d]):
+                n_d = max(0, n_d - 2 * int(gg.overlaps[d]))
+            interior *= n_d
+        interior_frac = interior / max(1, local_cells)
+    exposed = max(0.0, comm_s - compute_s * interior_frac) if overlap \
+        else comm_s
     step_s = compute_s + exposed
 
     # roofline verdict: the largest EXPOSED term names the regime
@@ -334,6 +377,7 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
         "comm": comm,
         "local_copy_s": local_copy_s,
         "comm_s": comm_s,
+        "interior_frac": interior_frac,
         "exposed_comm_s": exposed,
         "step_s": step_s,
         "bound": bound,

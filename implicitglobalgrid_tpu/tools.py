@@ -431,10 +431,11 @@ def _cli(argv=None) -> int:
                      help="CollectiveContract JSON to check --hlo against "
                           "(default: lints only)")
     aud.add_argument("--impl", default="xla",
-                     help="model step implementation (default xla — the "
-                          "path the static plan prices; any other impl "
-                          "audits lints only, contract+crosscheck "
-                          "skipped)")
+                     help="model step implementation (default xla; "
+                          "pallas/pallas_interpret audit the fused tier "
+                          "under the SAME byte-exact contract + "
+                          "crosscheck — both tiers ride the canonical "
+                          "wire schema)")
     aud.add_argument("--wire-dtype", default=None,
                      help="reduced-precision wire format the exchange was "
                           "built with — float casts (bfloat16/float16), "

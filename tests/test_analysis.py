@@ -484,6 +484,41 @@ def test_parse_int8_quant_fixture():
     assert exact_bytes / (2 * expect * 8) > 3.5
 
 
+def test_parse_interior_first_fixture():
+    """Golden INTERIOR-FIRST chunk program (ISSUE 11): the lowered
+    StableHLO of the overlapped diffusion step on the 2x2x2 periodic mesh
+    (16^3 local blocks, ol=2 -> 12^3 interior). The fixture proves —
+    host-only, via `ProgramIR.closure` — the structural claim of the
+    interior-first step shape: one ppermute pair per exchanging axis,
+    every permute slab-sized, an `optimization_barrier` guarding the
+    stitch, and interior-sized compute with NO SSA path to or from any
+    collective-permute (what lets the latency-hiding scheduler run the
+    interior under the wire)."""
+    ir = _fixture("overlap_interior_first.stablehlo.txt")
+    assert ir.dialect == "stablehlo"
+    permutes = ir.permutes
+    assert len(permutes) == 6  # one pair per exchanging axis
+    assert not ir.all_reduces and not ir.all_gathers
+    for op in permutes:
+        assert ir.payload_of(op).cells < 16 ** 3  # slab-sized
+    assert ir.find("optimization-barrier")
+    tainted = ir.closure(permutes, "up") | ir.closure(permutes, "down") \
+        | set(permutes)
+
+    def interior_sized(op):
+        return any(s.dtype == "f32" and s.dims == (12, 12, 12)
+                   for s in op.shapes)
+
+    interior_ops = {"add", "multiply", "subtract", "divide", "select",
+                    "dynamic-update-slice"}
+    independent = [op for op in ir.ops
+                   if op.op in interior_ops and interior_sized(op)
+                   and op not in tainted]
+    assert independent, (
+        "no interior-sized compute is independent of the permutes — the "
+        "interior-first shape degraded to a serialized exchange")
+
+
 def test_run_lints_unknown_rule_raises():
     ir = _fixture("exchange_all_self.hlo.txt")
     with pytest.raises(InvalidArgumentError):

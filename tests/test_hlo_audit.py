@@ -361,10 +361,11 @@ def _compiled_step_ir(impl, ndim=3):
 
 
 def _fused_contract(local_shape, n_permutes):
-    """The fused kernels exchange per-field IN-kernel, so their permute
-    counts are pinned explicitly (the coalescing plan does not price
-    them); slab bound, forbidden reductions/gathers, and route legality
-    still come from the subsystem."""
+    """Structural pin of a fused program's permute count: slab bound,
+    forbidden reductions/gathers, and route legality from the subsystem
+    (the full byte-exact plan contracts are checked by the audit_model
+    tests below — since the fused tier rides the canonical wire schema,
+    those are REAL `model_contract`s, not a per-field carve-out)."""
     from implicitglobalgrid_tpu.analysis import axis_routes
 
     return CollectiveContract(
@@ -426,8 +427,12 @@ def test_fused_step_2d_permutes():
 
 
 def test_fused_acoustic_permutes():
-    """Fused acoustic pass on a 2x2x2 periodic mesh: 4 fields x 3 axes x 2
-    directions = 24 slab-sized permutes, nothing else."""
+    """Fused acoustic pass on a 2x2x2 periodic mesh: all 4 fields ride the
+    canonical PACKED wire (one ppermute pair per mesh axis for the whole
+    round — `exchange_recv_slabs_multi`) = 6 slab-sized permutes, down
+    from the pre-schema per-field 24, byte-exact to the fused-round
+    contract."""
+    from implicitglobalgrid_tpu.analysis import model_contract
     from implicitglobalgrid_tpu.models import (
         init_acoustic3d, make_acoustic_run,
     )
@@ -436,20 +441,28 @@ def test_fused_acoustic_permutes():
                          periodx=1, periody=1, periodz=1, quiet=True)
     state, p = init_acoustic3d(dtype=np.float32)
     fn = make_acoustic_run(p, 1, impl="pallas_interpret")
-    _assert_fused(parse_program(fn, *state), (8, 8, 16), 24)
+    ir = parse_program(fn, *state)
+    _assert_fused(ir, (8, 8, 16), 6)
+    contract = model_contract("acoustic3d", state, impl="pallas")
+    assert all(v["permutes"] == 2 for v in contract.axes.values())
+    _assert_honors(ir, contract)
 
 
 def test_fused_stokes_permutes():
     """Fused Stokes pass on a 2x2x2 periodic mesh: the 4 EXCHANGED fields
-    (Pn, Vx, Vy, Vz) x 3 axes x 2 directions = 24 slab-sized permutes —
-    the dV fields must not add wire traffic."""
+    (Pn, Vx, Vy, Vz) pack into one ppermute pair per mesh axis = 6
+    slab-sized permutes (pre-schema: 24 per-field) — the dV fields must
+    not add wire traffic, and the payload is byte-exact to the plan."""
+    from implicitglobalgrid_tpu.analysis import model_contract
     from implicitglobalgrid_tpu.models import init_stokes3d, make_stokes_run
 
     igg.init_global_grid(8, 8, 16, dimx=2, dimy=2, dimz=2,
                          periodx=1, periody=1, periodz=1, quiet=True)
     state, p = init_stokes3d(dtype=np.float32)
     fn = make_stokes_run(p, 1, impl="pallas_interpret")
-    _assert_fused(parse_program(fn, *state), (8, 8, 16), 24)
+    ir = parse_program(fn, *state)
+    _assert_fused(ir, (8, 8, 16), 6)
+    _assert_honors(ir, model_contract("stokes3d", state, impl="pallas"))
 
 
 @pytest.mark.slow
@@ -559,6 +572,104 @@ def test_overlap_interior_independent_of_permutes():
     assert any(interior_sized(prod) and prod not in tainted
                for prod in barrier_feeds), (
         "optimization_barrier does not guard the interior result")
+
+
+def _assert_interior_first(ir, min_cells, n_permutes):
+    """Structural interior-first claim on a LOWERED step program: the
+    expected permute count, an optimization_barrier guarding the stitch,
+    and interior-scale f32 compute with NO SSA path to or from any
+    collective-permute (`ProgramIR.closure`)."""
+    permutes = ir.permutes
+    assert len(permutes) == n_permutes
+    assert ir.find("optimization-barrier"), (
+        "no optimization_barrier around the stitch — fusion is free to "
+        "serialize the interior after the collectives")
+    tainted = ir.closure(permutes, "up") | ir.closure(permutes, "down") \
+        | set(permutes)
+    interior_ops = {"add", "multiply", "subtract", "divide", "select",
+                    "dynamic-update-slice"}
+
+    def big(op):
+        return any(s.dtype == "f32" and s.dims
+                   and int(np.prod(s.dims)) >= min_cells
+                   for s in op.shapes)
+
+    independent = [op for op in ir.ops
+                   if op.op in interior_ops and big(op)
+                   and op not in tainted]
+    assert independent, (
+        "no interior-scale compute is independent of the collective-"
+        "permutes — the interior-first shape degraded to a serialized "
+        "exchange")
+
+
+def test_overlap_interior_first_acoustic_multi_field():
+    """The MULTI-FIELD interior-first round (the acoustic V round: three
+    STAGGERED outputs, ONE coalesced ppermute pair per axis) keeps its
+    collectives structurally independent of its interior update — the
+    live `ProgramIR.closure` check of the ISSUE-11 acceptance. Audited on
+    the round in isolation: in the full two-round step, round 2's shell
+    legitimately consumes round 1's exchanged halos, so the per-round
+    independence is the invariant (diffusion's single-field form is
+    audited above; the stokes 7-field single-round form rides the slow
+    tier; the golden host-only counterpart is
+    tests/data/hlo/overlap_interior_first.stablehlo.txt)."""
+    from jax import lax
+
+    from implicitglobalgrid_tpu.models import init_acoustic3d
+    from implicitglobalgrid_tpu.models.common import interior_first_step
+
+    igg.init_global_grid(12, 12, 12, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    gg = igg.global_grid()
+    (Pf, Vx, Vy, Vz), p = init_acoustic3d(dtype=np.float32, overlap=True)
+
+    def dP(A, d):
+        n = A.shape[d]
+        return (lax.slice_in_dim(A, 1, n, axis=d)
+                - lax.slice_in_dim(A, 0, n - 1, axis=d))
+
+    def v_upd(vx, vy, vz, Pc):
+        vx = vx.at[1:-1, :, :].add(-p.dt / p.rho * dP(Pc, 0) / p.dx)
+        vy = vy.at[:, 1:-1, :].add(-p.dt / p.rho * dP(Pc, 1) / p.dy)
+        vz = vz.at[:, :, 1:-1].add(-p.dt / p.rho * dP(Pc, 2) / p.dz)
+        return vx, vy, vz
+
+    from jax.sharding import PartitionSpec as P
+    import jax
+
+    spec = P("gx", "gy", "gz")
+    fn = jax.jit(shard_map(
+        lambda vx, vy, vz, Pc: interior_first_step(
+            v_upd, (vx, vy, vz), (Pc,), radius=1),
+        mesh=gg.mesh, in_specs=(spec,) * 4, out_specs=(spec,) * 3))
+    ir = parse_program(fn, Vx, Vy, Vz, Pf, optimized=False)
+    # one coalesced 3-field pair per exchanging axis
+    _assert_interior_first(ir, min_cells=(12 - 4) ** 3, n_permutes=6)
+
+
+@pytest.mark.slow
+def test_overlap_interior_first_stokes_multi_field():
+    """The 7-output / 4-exchanged stokes interior-first iteration: one
+    coalesced (Vx, Vy, Vz, Pn) ppermute round per axis, interior PT
+    update independent of every permute."""
+    from implicitglobalgrid_tpu.models import (
+        init_stokes3d, stokes_step_local,
+    )
+
+    igg.init_global_grid(12, 12, 12, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    gg = igg.global_grid()
+    state, p = init_stokes3d(dtype=np.float32, overlap=True)
+    from jax.sharding import PartitionSpec as P
+    import jax
+
+    spec = P("gx", "gy", "gz")
+    fn = jax.jit(shard_map(
+        lambda *s: stokes_step_local(s, p, impl="xla"),
+        mesh=gg.mesh, in_specs=(spec,) * 8, out_specs=(spec,) * 8))
+    ir = parse_program(fn, *state, optimized=False)
+    _assert_interior_first(ir, min_cells=(12 - 4) ** 3, n_permutes=6)
 
 
 def test_guarded_runner_adds_exactly_one_small_allreduce():
@@ -814,18 +925,25 @@ def test_permute_count_with_halowidth_2():
     _assert_honors(_compiled_exchange(args), contract)
 
 
-@pytest.mark.parametrize("model", ["diffusion3d", "acoustic3d", "stokes3d"])
-def test_audit_model_crosschecks_perfmodel(model):
-    """ISSUE-7 acceptance: for each model family, the perf oracle's priced
-    ppermute PAIRS and all-links wire bytes (`predict_step` over
-    `STEP_WORKLOADS` exchange rounds) EQUAL what the compiler actually
-    emitted, per mesh axis, on the CPU mesh — static-model drift is a
-    caught `perfmodel-drift` finding, not a silent mispricing. The same
-    call also proves the plan-derived contract: slab-sized payloads on
-    legal routes, exact per-axis counts, no gathers."""
+@pytest.mark.parametrize("model,impl", [
+    ("diffusion3d", "xla"), ("acoustic3d", "xla"), ("stokes3d", "xla"),
+    # the fused tier's fast tier-1 representative: same byte-exact
+    # contract + crosscheck, via the canonical wire schema (the per-model
+    # fused matrix rides the audit tests above / the slow tier)
+    ("diffusion3d", "pallas_interpret"),
+])
+def test_audit_model_crosschecks_perfmodel(model, impl):
+    """ISSUE-7 acceptance (extended to EVERY kernel tier): for each model
+    family, the perf oracle's priced ppermute PAIRS and all-links wire
+    bytes (`predict_step` over the tier's `StepWorkload.groups_for`
+    rounds) EQUAL what the compiler actually emitted, per mesh axis, on
+    the CPU mesh — static-model drift is a caught `perfmodel-drift`
+    finding, not a silent mispricing. The same call also proves the
+    plan-derived contract: slab-sized payloads on legal routes, exact
+    per-axis counts, no gathers."""
     igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2,
                          periodx=1, periody=1, periodz=1, quiet=True)
-    rep = igg.audit_model(model)
+    rep = igg.audit_model(model, impl=impl)
     assert rep.ok, [f.to_json() for f in rep.findings]
     cc = rep.crosscheck
     assert cc is not None and cc["ok"]
@@ -859,19 +977,44 @@ def test_audit_model_wire_dtype_self_contained(monkeypatch):
 
 
 @pytest.mark.slow
-def test_audit_model_non_xla_impl_skips_contract():
-    """The static plan prices the impl="xla" exchange structure only —
-    the fused kernels exchange per-field in-kernel (their permute counts
-    are pinned by the explicit fused audits above). `audit_model` on any
-    other impl must therefore run LINTS ONLY: no contract, no perfmodel
-    crosscheck, `meta["contract_skipped"]` recording why — so the CLI's
-    documented exit-1 gate never fails a healthy fused program on a
-    contract it was never meant to honor."""
+def test_audit_model_fused_fallback_contract_follows_xla_rounds():
+    """REGRESSION (review finding): on a grid the fused kernel's
+    eligibility gate rejects (halowidth != 1 — the deep-halo
+    configuration), a Pallas request falls back to the XLA formulation;
+    the contract must follow the FALLBACK's rounds (acoustic: V round +
+    P round = 2 pairs/axis), not the requested fused grouping (1
+    pair/axis) — else `tools audit` exit-1-fails a healthy program, the
+    false-failure class the retired exemption existed to prevent."""
+    igg.init_global_grid(12, 12, 12, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1,
+                         overlaps=(4, 4, 4), halowidths=(2, 2, 2),
+                         quiet=True)
+    rep = igg.audit_model("acoustic3d", impl="pallas_interpret")
+    assert rep.ok, [f.to_json() for f in rep.findings]
+    assert rep.crosscheck is not None and rep.crosscheck["ok"]
+    assert "rounds_impl" in rep.meta  # the fallback was recorded
+    # XLA rounds: V round + P round -> 4 permutes per exchanging axis
+    assert all(v["permutes"] == 4 for v in rep.contract.axes.values())
+
+
+@pytest.mark.slow
+def test_audit_model_fused_tier_has_real_contract():
+    """REGRESSION (reversal of the PR-7 carve-out): `audit_model` on a
+    fused Pallas impl used to SKIP the contract and crosscheck
+    (`meta["contract_skipped"]`) because the fused kernels exchanged
+    per-field in-kernel. The canonical wire schema retired that — the
+    fused tier ships the same packed one-pair-per-axis wire the plan
+    prices, so a Pallas audit must now carry a REAL byte-exact contract
+    AND a passing perfmodel crosscheck, and the `tools audit` exit-1
+    gate covers fused programs. (Fast representative:
+    test_audit_model_crosschecks_perfmodel's pallas leg.)"""
     igg.init_global_grid(8, 8, 16, dimx=2, dimy=2, dimz=2,
                          periodx=1, periody=1, periodz=1, quiet=True)
-    rep = igg.audit_model("diffusion3d", impl="pallas_interpret")
+    rep = igg.audit_model("acoustic3d", impl="pallas_interpret")
     assert rep.ok, [f.to_json() for f in rep.findings]
-    assert rep.contract is None and rep.crosscheck is None
-    assert "contract_skipped" in rep.meta
-    # the program still parsed and summarized (lints DID run over it)
+    assert rep.contract is not None
+    assert rep.crosscheck is not None and rep.crosscheck["ok"]
+    assert "contract_skipped" not in rep.meta
+    # the fused pass packs all 4 fields into ONE round: 2 permutes/axis
     assert rep.collectives["permutes"] == 6
+    assert all(v["permutes"] == 2 for v in rep.contract.axes.values())

@@ -117,6 +117,96 @@ def test_thin_block_fallback():
     assert np.array_equal(a, b)
 
 
+@pytest.mark.parametrize("wire", ["z:int8", "z:int8,x:f32"])
+def test_overlapped_equals_plain_quantized_wire(wire):
+    """ISSUE 11 small fix: the overlapped path and the plain fallback must
+    agree under QUANTIZED per-axis wire policies too (previously only the
+    exact wire was asserted). Equality holds because the send slabs are
+    extracted from the shell, whose values equal the plain update's — so
+    the per-slab max-abs quantization scales cannot diverge between the
+    paths; a shell that drifted by even one ulp would flip quantization
+    bins and fail this test loudly."""
+    igg.init_global_grid(12, 12, 12, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periodz=1, quiet=True)
+    gg = igg.global_grid()
+    T, Cp, p = init_diffusion3d(dtype=np.float64)
+    up = _update(p)
+    spec = P("gx", "gy", "gz")
+
+    plain = jax.jit(shard_map(
+        lambda t, c: igg.local_update_halo(up(t, c), wire_dtype=wire),
+        mesh=gg.mesh, in_specs=(spec, spec), out_specs=spec))
+    overlapped = jax.jit(shard_map(
+        lambda t, c: hide_communication(up, t, c, radius=1,
+                                        wire_dtype=wire),
+        mesh=gg.mesh, in_specs=(spec, spec), out_specs=spec))
+    a = np.asarray(plain(T, Cp))
+    b = np.asarray(overlapped(T, Cp))
+    igg.finalize_global_grid()
+    assert_overlap_equal(a, b)
+
+
+def test_multi_field_overlap_staggered_equals_plain():
+    """The MULTI-FIELD interior-first shape (`hide_communication` on a
+    tuple of staggered outputs — the acoustic V round's form): one
+    coalesced exchange round of all outputs, same values as plain
+    update-then-exchange."""
+    from implicitglobalgrid_tpu.models import init_acoustic3d
+
+    igg.init_global_grid(12, 12, 12, dimx=2, dimy=2, dimz=2,
+                         periodx=1, quiet=True)
+    gg = igg.global_grid()
+    (Pf, Vx, Vy, Vz), p = init_acoustic3d(dtype=np.float64)
+    from jax import lax
+
+    def dP(A, d):
+        n = A.shape[d]
+        return (lax.slice_in_dim(A, 1, n, axis=d)
+                - lax.slice_in_dim(A, 0, n - 1, axis=d))
+
+    def v_upd(vx, vy, vz, Pc):
+        vx = vx.at[1:-1, :, :].add(-p.dt / p.rho * dP(Pc, 0) / p.dx)
+        vy = vy.at[:, 1:-1, :].add(-p.dt / p.rho * dP(Pc, 1) / p.dy)
+        vz = vz.at[:, :, 1:-1].add(-p.dt / p.rho * dP(Pc, 2) / p.dz)
+        return vx, vy, vz
+
+    spec = P("gx", "gy", "gz")
+    specs = (spec, spec, spec, spec)
+
+    plain = jax.jit(shard_map(
+        lambda vx, vy, vz, Pc: igg.local_update_halo(*v_upd(vx, vy, vz, Pc)),
+        mesh=gg.mesh, in_specs=specs, out_specs=specs[:3]))
+    overlapped = jax.jit(shard_map(
+        lambda vx, vy, vz, Pc: hide_communication(
+            v_upd, (vx, vy, vz), Pc, radius=1),
+        mesh=gg.mesh, in_specs=specs, out_specs=specs[:3]))
+    a = plain(Vx, Vy, Vz, Pf)
+    b = overlapped(Vx, Vy, Vz, Pf)
+    igg.finalize_global_grid()
+    for x, y in zip(a, b):
+        assert_overlap_equal(np.asarray(x), np.asarray(y))
+
+
+def test_stokes_overlap_matches_plain():
+    """StokesParams(overlap=True) routes the XLA PT iteration through the
+    interior-first shape (7 shell updates, one coalesced 4-field round,
+    interior under the collectives); results must match the plain path
+    (bit-identical on the jax>=0.6 toolchain; ulp tolerance on 0.4.x —
+    `assert_overlap_equal`, same caveat as the step's own docstring)."""
+    import dataclasses
+
+    from implicitglobalgrid_tpu.models import init_stokes3d, run_stokes
+
+    igg.init_global_grid(12, 12, 12, dimx=2, dimy=2, dimz=2, quiet=True)
+    state, p = init_stokes3d(dtype=np.float32)
+    a = run_stokes(state, p, 6, nt_chunk=3, impl="xla")
+    po = dataclasses.replace(p, overlap=True)
+    b = run_stokes(state, po, 6, nt_chunk=3, impl="xla")
+    igg.finalize_global_grid()
+    for x, y in zip(a, b):
+        assert_overlap_equal(np.asarray(x), np.asarray(y), steps=6)
+
+
 def test_diffusion_overlap_matches_plain():
     """DiffusionParams(overlap=True) routes the XLA step through
     hide_communication; results must equal the plain path bit-for-bit."""

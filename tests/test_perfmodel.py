@@ -93,12 +93,16 @@ def test_comm_every_and_overlap_pricing():
     for ax in p1["comm"]:
         assert p4["comm"][ax]["latency_s"] == pytest.approx(
             p1["comm"][ax]["latency_s"] / 4)
-    # overlap credits comm that hides behind compute
+    # overlap credits comm that hides behind INTERIOR compute (the shell
+    # update serializes before the collectives — priced from the slab
+    # geometry, so the credit shrinks with the interior fraction)
     po = igg.predict_step("diffusion3d", (T, Cp), profile=prof,
                           overlap=True)
+    assert 0.0 < po["interior_frac"] < 1.0
     assert po["exposed_comm_s"] == pytest.approx(
-        max(0.0, po["comm_s"] - po["compute"]["s"]))
+        max(0.0, po["comm_s"] - po["compute"]["s"] * po["interior_frac"]))
     assert po["step_s"] <= p1["step_s"]
+    assert p1["interior_frac"] == 1.0  # no overlap: nothing serializes
 
 
 def test_wire_dtype_halves_wire_bytes():

@@ -1,0 +1,195 @@
+"""Property tests of the canonical wire schema (`ops/wire.py`) — ONE
+parametrized suite covering layout round-trips for every dtype x format x
+tier combination, so the XLA coalesced pack and the Pallas fused pack can
+never drift apart (they are the same `WireSchema` program; the Pallas
+tier is exercised through `wire_pack_pallas` in interpret mode).
+
+Tier-1 keeps one fast representative per property; the full
+dtype x format x tier matrix rides the ``slow`` marker (ROADMAP tier-1
+budget note).
+"""
+
+import numpy as np
+import pytest
+
+from implicitglobalgrid_tpu.ops.precision import (
+    SCALE_BYTES, WireFormat, quant_slab_bytes,
+)
+from implicitglobalgrid_tpu.ops.wire import slab_schema, schema_for_fields
+from implicitglobalgrid_tpu.utils.exceptions import InvalidArgumentError
+
+
+def _slabs(shapes, dtype, seed=0):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for s in shapes:
+        a = rng.standard_normal(s) * 3.0
+        out.append(jnp.asarray(a).astype(dtype))
+    return out
+
+
+def _roundtrip(schema, slabs, pallas=False):
+    mode = (True, True) if pallas else None
+    buf = schema.pack(slabs, pallas_mode=mode)
+    return buf, schema.unpack(buf)
+
+
+def _assert_exact_roundtrip(shapes, dtype, dim, pallas=False):
+    import jax.numpy as jnp
+
+    slabs = _slabs(shapes, dtype)
+    schema = slab_schema(dim, shapes, dtype)
+    assert schema.fmt is None and not schema.is_quant
+    buf, back = _roundtrip(schema, slabs, pallas=pallas)
+    # byte accounting is exact: the packed buffer IS payload_bytes long
+    assert buf.size * buf.dtype.itemsize == schema.payload_bytes
+    assert buf.dtype == jnp.asarray(slabs[0]).dtype
+    for a, b in zip(slabs, back):
+        assert b.shape == a.shape and b.dtype == a.dtype
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- fast tier-1 representatives (one per property) -------------------------
+
+def test_exact_roundtrip_slab_layout():
+    """Exact wire, uniform cross-shapes -> the SLAB layout (concat along
+    the exchange axis, no ravel): bitwise round-trip, byte-exact
+    accounting."""
+    shapes = [(1, 6, 8)] * 4
+    schema = slab_schema(0, shapes, np.float32)
+    assert schema.layout == "slab"
+    _assert_exact_roundtrip(shapes, np.float32, 0)
+
+
+def test_exact_roundtrip_flat_layout_staggered():
+    """Mixed (staggered) cross-shapes force the FLAT layout — the fused
+    multi-field packs (P, Vx, Vy, Vz): still a bitwise round-trip."""
+    shapes = [(1, 6, 8), (1, 7, 8), (1, 6, 9)]
+    schema = slab_schema(0, shapes, np.float32)
+    assert schema.layout == "flat"
+    _assert_exact_roundtrip(shapes, np.float32, 0)
+
+
+def test_quant_roundtrip_matches_per_slab_codec():
+    """int8 wire: the packed buffer is slabs + the SCALE_BYTES f32 tail,
+    and unpack reproduces the per-slab quantize/dequantize reference
+    EXACTLY (each slab against its own scale); constant slabs round-trip
+    bit-for-bit."""
+    from implicitglobalgrid_tpu.ops.precision import (
+        dequantize_slab, quantize_slab,
+    )
+
+    fmt = WireFormat("int8")
+    shapes = [(1, 4, 8), (1, 4, 8)]
+    slabs = _slabs(shapes, np.float32)
+    schema = slab_schema(2, shapes, np.float32, fmt)
+    assert schema.layout == "flat" and schema.is_quant
+    buf, back = _roundtrip(schema, slabs)
+    assert buf.size == schema.payload_bytes \
+        == sum(quant_slab_bytes(32, fmt) for _ in shapes) + 2 * SCALE_BYTES
+    for a, b in zip(slabs, back):
+        q, scale = quantize_slab(a.reshape(-1), fmt)
+        ref = dequantize_slab(q, scale, a.size, fmt, a.dtype).reshape(a.shape)
+        assert np.array_equal(np.asarray(ref), np.asarray(b))
+    # constant slabs are EXACT through the quant codec
+    import jax.numpy as jnp
+
+    const = [jnp.full(s, 2.5, np.float32) for s in shapes]
+    _, back = _roundtrip(schema, const)
+    for a, b in zip(const, back):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pallas_pack_matches_xla_pack():
+    """The fused Pallas pack (interpret mode — the TPU tier's kernel) is
+    BIT-IDENTICAL to the XLA concat pack: one schema, two executors."""
+    import jax.numpy as jnp
+
+    shapes = [(1, 8, 128)] * 3
+    slabs = _slabs(shapes, np.float32)
+    schema = slab_schema(0, shapes, np.float32)
+    a = schema.pack(slabs)
+    b = schema.pack(slabs, pallas_mode=(True, True))
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert np.array_equal(np.asarray(a),
+                          np.asarray(jnp.concatenate(slabs, axis=0)))
+
+
+def test_schema_for_fields_matches_plan_geometry():
+    """`schema_for_fields` derives slab shapes from field shapes + hw —
+    the single geometry rule the static plan prices. Byte accounting must
+    equal cells x itemsize (exact) and quant cells + scales (int8)."""
+    fields = [(8, 6, 8), (9, 6, 8)]
+    sch = schema_for_fields(0, fields, [1, 1], np.float64)
+    assert sch.shapes == ((1, 6, 8), (1, 6, 8))
+    assert sch.payload_bytes == 2 * 48 * 8
+    q = schema_for_fields(0, fields, [1, 1], np.float64, WireFormat("int4"))
+    assert q.payload_bytes == 2 * quant_slab_bytes(48, WireFormat("int4")) \
+        + 2 * SCALE_BYTES
+    assert q.wire_key == "int4" and str(q.wire_dtype) == "int8"
+
+
+def test_schema_validates_slab_shapes():
+    schema = slab_schema(0, [(1, 4, 4)], np.float32)
+    with pytest.raises(InvalidArgumentError):
+        schema.pack(_slabs([(1, 4, 5)], np.float32))
+    with pytest.raises(InvalidArgumentError):
+        schema.pack(_slabs([(1, 4, 4)] * 2, np.float32))
+    with pytest.raises(InvalidArgumentError):
+        slab_schema(0, [], np.float32)
+
+
+# -- the full matrix (slow tier) --------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("tier", ["xla", "pallas"])
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, "bfloat16"])
+@pytest.mark.parametrize("dim", [0, 1, 2])
+def test_exact_roundtrip_matrix(tier, dtype, dim):
+    """Layout round-trip exactness for every state dtype on every exchange
+    axis, both tiers (the Pallas pack covers dims 0/1; dim 2 and the flat
+    layout stay XLA by design — `wire_pack_supported`)."""
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if dtype == "bfloat16" else dtype
+    shapes = [tuple(2 if d == dim else 8 for d in range(3))] * 3
+    pallas = tier == "pallas"
+    if pallas:
+        from implicitglobalgrid_tpu.ops.pallas_halo import wire_pack_supported
+
+        schema = slab_schema(dim, shapes, dtype)
+        if not wire_pack_supported(schema.shapes, dim, schema.state_dtype):
+            pytest.skip("pallas pack unsupported on this axis (by design)")
+    _assert_exact_roundtrip(shapes, dtype, dim, pallas=pallas)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fmt_name", ["int8", "int4", "bfloat16"])
+@pytest.mark.parametrize("state_dtype", [np.float32, np.float64])
+def test_reduced_wire_roundtrip_matrix(fmt_name, state_dtype):
+    """Quantized (int8/int4) and cast (bf16) formats: unpack returns the
+    state dtype, byte accounting is exact, values match the per-slab
+    reference codec (quant) or the cast round-trip (bf16)."""
+    import jax.numpy as jnp
+
+    fmt = WireFormat(fmt_name)
+    shapes = [(2, 4, 8), (2, 4, 8), (2, 4, 8)]
+    slabs = _slabs(shapes, state_dtype)
+    schema = slab_schema(1, shapes, state_dtype, fmt)
+    buf, back = _roundtrip(schema, slabs)
+    assert int(buf.size) * int(buf.dtype.itemsize) == schema.payload_bytes
+    for a, b in zip(slabs, back):
+        assert b.dtype == a.dtype and b.shape == a.shape
+        if fmt.is_quant:
+            from implicitglobalgrid_tpu.ops.precision import (
+                dequantize_slab, quantize_slab,
+            )
+
+            q, scale = quantize_slab(a.reshape(-1), fmt)
+            ref = dequantize_slab(q, scale, a.size, fmt,
+                                  a.dtype).reshape(a.shape)
+        else:
+            ref = a.astype(jnp.bfloat16).astype(a.dtype)
+        assert np.array_equal(np.asarray(ref), np.asarray(b))
