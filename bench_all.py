@@ -150,6 +150,23 @@ def main() -> None:
         "speedup_8fields": speed8,
     }))
 
+    # --- ensemble axis: per-member step vs solo at E=4/8/16 (ISSUE 12) -----
+    # one vmapped chunk advances E scenario members behind the SAME
+    # collectives; per-member speedup rows ride the perfdb gate and two
+    # absolute gates travel with them: compiled permute+psum count at E=8
+    # equals E=1 (`ensemble_permutes_flat_ok`) and per-member step within
+    # 10% of solo (`ensemble_amortization_ok`). Config owned by
+    # `bench_ensemble.run_ensemble_ab` (shared with the standalone bench).
+    import bench_ensemble
+
+    ensemble_rows = bench_ensemble.run_ensemble_ab(dims3, cpu)
+    for row in ensemble_rows:
+        results.append(bench_util.emit(row))
+    ensemble_ok = all(
+        r["value"] >= 1.0 for r in ensemble_rows
+        if r["metric"] in ("ensemble_permutes_flat_ok",
+                           "ensemble_amortization_ok"))
+
     # --- quantized halo wire A/B (ISSUE 10) --------------------------------
     # static f32/int8 wire-byte ratio at 4 coalesced fields (payload +
     # per-slab scales), the quantize/dequantize overhead gate on the live
@@ -291,7 +308,8 @@ def main() -> None:
     with open("BENCH_ALL.json", "w") as f:
         json.dump(results, f, indent=1)
     lint_failed = not ruff_missing and lint.returncode != 0
-    if (not gate["ok"] or lint_failed or not coalesce8_ok) \
+    if (not gate["ok"] or lint_failed or not coalesce8_ok
+            or not ensemble_ok) \
             and os.environ.get("IGG_BENCH_STRICT") == "1":
         sys.exit(1)
 

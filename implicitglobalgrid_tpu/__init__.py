@@ -77,6 +77,7 @@ from .telemetry import (
     load_machine_profile, save_machine_profile, predict_step,
     calibrate_machine, perfdb_add, perfdb_check,
 )
+from .models.common import ensemble_partition_spec, ensemble_state
 from . import io
 from .io import (
     SnapshotWriter, write_snapshot, open_snapshot, list_snapshots,
@@ -110,6 +111,8 @@ __all__ = [
     "save_checkpoint", "restore_checkpoint", "load_checkpoint",
     "save_checkpoint_sharded", "restore_checkpoint_sharded",
     "restore_checkpoint_elastic", "saved_topology", "elastic_local_size",
+    # ensemble axis (batch E scenario members through one mesh)
+    "ensemble_state", "ensemble_partition_spec",
     # resilient runtime (supervised long runs)
     "run_resilient", "ResilientRun", "RunSpec",
     "GuardConfig", "HealthReport", "RecoveryPolicy",

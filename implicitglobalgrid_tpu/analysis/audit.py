@@ -135,26 +135,37 @@ def _maybe_routes():
 # ---------------------------------------------------------------------------
 # model programs
 
-def _model_program(model: str, impl: str, dtype):
-    """(runner, example args, state fields in canonical order)."""
+def _model_program(model: str, impl: str, dtype, ensemble=None):
+    """(runner, example args, PHYSICAL state fields in canonical order).
+    With ``ensemble=E`` the runner is the E-member batched chunk
+    (`make_*_run(..., ensemble=E)`) and ``args`` are the member-stacked
+    arrays — ``fields`` stay the per-member state the contracts price."""
     from .. import models as M
 
     if model in ("diffusion3d", "diffusion2d"):
         ndim = 3 if model.endswith("3d") else 2
         init = M.init_diffusion3d if ndim == 3 else M.init_diffusion2d
         T, Cp, p = init(dtype=dtype)
-        return M.make_run(p, 1, ndim=ndim, impl=impl), (T, Cp), (T, Cp)
-    if model == "acoustic3d":
+        run = M.make_run(p, 1, ndim=ndim, impl=impl, ensemble=ensemble)
+        args = (T, Cp)
+    elif model == "acoustic3d":
         state, p = M.init_acoustic3d(dtype=dtype)
-        return M.make_acoustic_run(p, 1, impl=impl), tuple(state), \
-            tuple(state)
-    if model == "stokes3d":
+        run = M.make_acoustic_run(p, 1, impl=impl, ensemble=ensemble)
+        args = tuple(state)
+    elif model == "stokes3d":
         state, p = M.init_stokes3d(dtype=dtype)
-        return M.make_stokes_run(p, 1, impl=impl), tuple(state), \
-            tuple(state)
-    raise InvalidArgumentError(
-        f"audit_model: unknown model {model!r} (have diffusion3d, "
-        "diffusion2d, acoustic3d, stokes3d).")
+        run = M.make_stokes_run(p, 1, impl=impl, ensemble=ensemble)
+        args = tuple(state)
+    else:
+        raise InvalidArgumentError(
+            f"audit_model: unknown model {model!r} (have diffusion3d, "
+            "diffusion2d, acoustic3d, stokes3d).")
+    fields = args
+    if ensemble is not None:
+        from ..models.common import ensemble_state
+
+        args = ensemble_state(args, int(ensemble))
+    return run, args, fields
 
 
 def _rounds_impl(model: str, impl: str, fields) -> str:
@@ -191,7 +202,8 @@ def _rounds_impl(model: str, impl: str, fields) -> str:
 
 def audit_model(model: str, *, impl: str = "xla", dtype=None,
                 wire_dtype=None, lints=None, crosscheck: bool = True,
-                optimized: bool = True) -> AuditReport:
+                optimized: bool = True,
+                ensemble: int | None = None) -> AuditReport:
     """Compile one model family's step program on the CURRENT grid and
     audit it against its plan-derived contract.
 
@@ -226,6 +238,9 @@ def audit_model(model: str, *, impl: str = "xla", dtype=None,
     check_initialized()
     dtype = np.float32 if dtype is None else dtype
     meta = {"model": model, "impl": impl}
+    if ensemble is not None:
+        ensemble = int(ensemble)
+        meta["ensemble"] = ensemble
     saved_wire = os.environ.get("IGG_HALO_WIRE_DTYPE")
     try:
         if wire_dtype is not None:
@@ -249,7 +264,8 @@ def audit_model(model: str, *, impl: str = "xla", dtype=None,
                         "XLA:CPU normalizes narrow wire payloads back to "
                         "full precision in optimized HLO; audited the "
                         "lowered module instead")
-        runner, args, fields = _model_program(model, impl, dtype)
+        runner, args, fields = _model_program(model, impl, dtype,
+                                              ensemble=ensemble)
         ir = parse_program(runner, *args, optimized=optimized)
     finally:
         if saved_wire is None:
@@ -267,7 +283,7 @@ def audit_model(model: str, *, impl: str = "xla", dtype=None,
     contract = None
     if model in STEP_WORKLOADS:
         contract = model_contract(model, fields, wire_dtype=wire_dtype,
-                                  impl=rounds_impl)
+                                  impl=rounds_impl, ensemble=ensemble)
     cfg = default_lint_config(
         state_dtypes={str(np.dtype(getattr(f, "dtype", "float32")))
                       for f in fields},
@@ -277,7 +293,8 @@ def audit_model(model: str, *, impl: str = "xla", dtype=None,
     cc = None
     if crosscheck and model in STEP_WORKLOADS:
         cc = perfmodel_crosscheck(model, fields, ir,
-                                  wire_dtype=wire_dtype, impl=rounds_impl)
+                                  wire_dtype=wire_dtype, impl=rounds_impl,
+                                  ensemble=ensemble)
     if cc is None:
         return rep
     return AuditReport(
@@ -293,7 +310,8 @@ def audit_model(model: str, *, impl: str = "xla", dtype=None,
 
 def audit_chunk_program(runner, args, *, names, reducer_floats: int = 0,
                         contract: CollectiveContract | None = None,
-                        lints=None) -> AuditReport:
+                        lints=None,
+                        ensemble: int | None = None) -> AuditReport:
     """Audit a resilient chunk runner ONCE at compile time, without
     touching it: traces + lowers the jitted ``runner`` with the run's
     ``args`` and parses the StableHLO (no second backend compile — the
@@ -301,11 +319,14 @@ def audit_chunk_program(runner, args, *, names, reducer_floats: int = 0,
     audit). The default contract is the structural guard one
     (`guard_contract`): exactly one f32[2N + R] psum, no gathers; pass an
     explicit `CollectiveContract` (e.g. from `model_contract`) to also
-    pin the per-axis permute counts of a known step."""
+    pin the per-axis permute counts of a known step. ``ensemble=E``
+    widens the expected guard psum to the batched ``f32[E·(2N + R)]``
+    stats (still exactly one all-reduce)."""
     import numpy as np
 
     if contract is None:
-        contract = guard_contract(len(tuple(names)), reducer_floats)
+        contract = guard_contract(len(tuple(names)), reducer_floats,
+                                  ensemble=ensemble)
     state_dtypes = set()
     for a in args:
         try:
@@ -316,4 +337,6 @@ def audit_chunk_program(runner, args, *, names, reducer_floats: int = 0,
     return audit_program(runner, *args, contract=contract, lints=lints,
                          lint_config=cfg, optimized=False,
                          meta={"program": "chunk",
-                               "names": list(names)})
+                               "names": list(names),
+                               **({"ensemble": int(ensemble)}
+                                  if ensemble else {})})

@@ -235,7 +235,7 @@ def hlo_dtype(name) -> str:
 
 
 def _merged_plan(fields, rounds, *, dims=None, coalesce=None,
-                 wire_dtype=None) -> dict:
+                 wire_dtype=None, ensemble=None) -> dict:
     """Per-axis {ppermutes, wire_bytes, dtypes} merged over the exchange
     rounds exactly as `telemetry.predict_step` merges them: fields in one
     round coalesce, separate rounds pay separate permutes.
@@ -265,7 +265,8 @@ def _merged_plan(fields, rounds, *, dims=None, coalesce=None,
                 f"exchange round {tuple(group)} indexes past the "
                 f"{len(fields)} given fields.")
         sub = halo_comm_plan(*(fields[i] for i in group), dims=dims,
-                             coalesce=coalesce, wire_dtype=wire_dtype)
+                             coalesce=coalesce, wire_dtype=wire_dtype,
+                             ensemble=ensemble)
         for axis, rec in sub["axes"].items():
             n_lines = total // gdims[axis_dim[axis]]
             dst = merged.setdefault(
@@ -293,6 +294,7 @@ def _local_block_cells(fields) -> int:
 
 def exchange_contract(*fields, rounds=None, dims=None, coalesce=None,
                       wire_dtype=None, guard_floats: int | None = None,
+                      ensemble: int | None = None,
                       meta=None) -> CollectiveContract:
     """Derive the contract for an exchange (or a step program) over the
     CURRENT grid from the static wire plan alone.
@@ -302,14 +304,29 @@ def exchange_contract(*fields, rounds=None, dims=None, coalesce=None,
     exchange rounds as tuples of field indices (default: one coalesced
     round of every field — `STEP_WORKLOADS[...].exchange_groups` for a
     model step). ``guard_floats`` adds the resilient runtime's psum
-    expectation: exactly one f32 all-reduce of that many floats."""
+    expectation: exactly one f32 all-reduce of that many floats.
+    ``ensemble=E`` is the E-member batched program's contract (fields
+    stay the PHYSICAL per-member shapes): identical per-axis permute
+    COUNTS with byte-exact E-scaled payloads — the compiled proof that
+    collective count is flat in E — the slab bound widens to E x the
+    local block (a batched payload legitimately aggregates every
+    member's slabs), and ``guard_floats`` stays the PER-MEMBER float
+    count: the expected psum payload scales to ``f32[E·guard_floats]``
+    exactly like `guard_contract`."""
     from ..parallel.topology import check_initialized, global_grid
 
     check_initialized()
     gg = global_grid()
+    E = 1
+    if ensemble is not None:
+        E = int(ensemble)
+        if E < 1:
+            raise InvalidArgumentError(
+                f"exchange_contract: ensemble must be >= 1; got "
+                f"{ensemble}.")
     rounds = rounds if rounds is not None else (tuple(range(len(fields))),)
     merged = _merged_plan(fields, rounds, dims=dims, coalesce=coalesce,
-                          wire_dtype=wire_dtype)
+                          wire_dtype=wire_dtype, ensemble=ensemble)
     axes = {a: {"permutes": r["permutes"], "wire_bytes": r["wire_bytes"],
                 "dtypes": tuple(sorted(r["dtypes"]))}
             for a, r in merged.items() if r["permutes"]}
@@ -318,21 +335,24 @@ def exchange_contract(*fields, rounds=None, dims=None, coalesce=None,
         routes=axis_routes(gg),
         allreduces=0 if guard_floats is None else 1,
         allreduce_payload=(None if guard_floats is None
-                           else ("f32", int(guard_floats))),
-        max_payload_cells=_local_block_cells(fields),
+                           else ("f32", E * int(guard_floats))),
+        max_payload_cells=_local_block_cells(fields) * E,
         meta=dict(meta or {}, dims=[int(d) for d in gg.dims],
-                  periods=[int(p) for p in gg.periods]))
+                  periods=[int(p) for p in gg.periods],
+                  **({"ensemble": E} if E > 1 else {})))
 
 
 def model_contract(model, fields, *, dims=None, coalesce=None,
                    wire_dtype=None, impl: str = "xla",
-                   guard_floats: int | None = None) -> CollectiveContract:
+                   guard_floats: int | None = None,
+                   ensemble: int | None = None) -> CollectiveContract:
     """The step contract of a model family: exchange rounds from
     `telemetry.STEP_WORKLOADS[model]`, priced over the model's state
-    ``fields`` (canonical state order). ``impl`` picks the kernel tier's
-    rounds (`StepWorkload.groups_for`): both tiers ride the canonical
-    wire schema, so a fused Pallas program gets the same byte-exact
-    contract as the XLA path — only the round grouping may differ."""
+    ``fields`` (canonical state order — PHYSICAL per-member shapes when
+    ``ensemble`` is set). ``impl`` picks the kernel tier's rounds
+    (`StepWorkload.groups_for`): both tiers ride the canonical wire
+    schema, so a fused Pallas program gets the same byte-exact contract
+    as the XLA path — only the round grouping may differ."""
     from ..telemetry.perfmodel import STEP_WORKLOADS
 
     work = STEP_WORKLOADS.get(str(model))
@@ -343,19 +363,32 @@ def model_contract(model, fields, *, dims=None, coalesce=None,
     return exchange_contract(
         *fields, rounds=work.groups_for(impl), dims=dims,
         coalesce=coalesce, wire_dtype=wire_dtype, guard_floats=guard_floats,
+        ensemble=ensemble,
         meta={"model": str(model), "impl": str(impl)})
 
 
 def guard_contract(n_fields: int, reducer_floats: int = 0,
-                   meta=None) -> CollectiveContract:
+                   meta=None, ensemble: int | None = None
+                   ) -> CollectiveContract:
     """The resilient chunk program's structural contract when the step
     body is user code (per-axis permute counts unknowable): exactly one
-    f32[2N + R] guard psum, no gathers, no all-to-alls."""
+    f32[2N + R] guard psum, no gathers, no all-to-alls. With
+    ``ensemble=E`` the one psum carries every member's stats —
+    ``f32[E·(2N + R)]`` cells, still exactly one all-reduce (the
+    per-member verdicts ride one collective)."""
+    E = 1
+    if ensemble is not None:
+        E = int(ensemble)
+        if E < 1:
+            raise InvalidArgumentError(
+                f"guard_contract: ensemble must be >= 1; got {ensemble}.")
     return CollectiveContract(
         axes=None, routes=None, allreduces=1,
-        allreduce_payload=("f32", 2 * int(n_fields) + int(reducer_floats)),
+        allreduce_payload=("f32",
+                           E * (2 * int(n_fields) + int(reducer_floats))),
         meta=dict(meta or {}, n_fields=int(n_fields),
-                  reducer_floats=int(reducer_floats)))
+                  reducer_floats=int(reducer_floats),
+                  **({"ensemble": E} if E > 1 else {})))
 
 
 # ---------------------------------------------------------------------------
@@ -491,23 +524,29 @@ def check_contract(ir: ProgramIR, contract: CollectiveContract) -> list:
 
 def perfmodel_crosscheck(model, fields, ir: ProgramIR, *, profile=None,
                          dims=None, coalesce=None, wire_dtype=None,
-                         impl: str = "xla") -> dict:
+                         impl: str = "xla",
+                         ensemble: int | None = None) -> dict:
     """Prove `telemetry.predict_step`'s collective pricing against the
     compiled program: per mesh axis, the oracle's priced ppermute PAIRS
     and all-links wire bytes must equal what the parser measured in the
     program. Returns ``{"ok", "findings", "axes"}`` where each axis entry
     carries modeled vs parsed numbers — drift in the static model becomes
-    a caught ``perfmodel-drift`` finding instead of silent mispricing."""
+    a caught ``perfmodel-drift`` finding instead of silent mispricing.
+    With ``ensemble=E`` the oracle prices the E-member batched program
+    (same pairs, E x bytes) against the vmapped compile — proving the
+    amortization claim byte-exactly."""
     from ..parallel.topology import check_initialized, global_grid
     from ..telemetry.perfmodel import predict_step
 
     check_initialized()
     gg = global_grid()
     pred = predict_step(model, fields, profile=profile, dims=dims,
-                        coalesce=coalesce, wire_dtype=wire_dtype, impl=impl)
+                        coalesce=coalesce, wire_dtype=wire_dtype, impl=impl,
+                        ensemble=ensemble)
     plan = _merged_plan(fields,
                         _exchange_rounds(model, len(fields), impl),
-                        dims=dims, coalesce=coalesce, wire_dtype=wire_dtype)
+                        dims=dims, coalesce=coalesce, wire_dtype=wire_dtype,
+                        ensemble=ensemble)
     parsed = measure_axes(ir, axis_routes(gg))
     findings: list = []
     axes: dict = {}
@@ -554,6 +593,7 @@ def perfmodel_crosscheck(model, fields, ir: ProgramIR, *, profile=None,
             details=parsed[None]))
     return {"ok": not findings, "findings": findings, "axes": axes,
             "model": str(model), "impl": str(impl),
+            "ensemble": int(pred.get("ensemble", 1)),
             "profile_source": pred["profile_source"]}
 
 

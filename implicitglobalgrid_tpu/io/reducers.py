@@ -347,7 +347,15 @@ def make_reduced_post_chunk(names, plan: ReducerPlan):
     concatenate into ONE vector reduced by ONE psum over all mesh axes —
     the compiled chunk still carries exactly one tiny all-reduce
     (`tests/test_hlo_audit.py`). The driver slices the fetched vector:
-    ``[:2*nfields]`` health, ``[2*nfields:]`` reducers."""
+    ``[:2*nfields]`` health, ``[2*nfields:]`` reducers.
+
+    ENSEMBLE runs (ISSUE 12) vmap this hook over the member axis
+    (`make_state_runner(ensemble=E)`): the reducer segments gain a
+    per-member dimension — the fetched matrix is ``(E, 2N+R)``, each
+    scenario streaming its own probes/slices/stats behind the SAME single
+    psum — and the driver decodes each member's tail with this plan
+    (labels suffixed ``[m<member>]``). The plan itself is built over the
+    PER-MEMBER (physical) shapes; nothing here changes."""
     from jax import lax
 
     from ..runtime.health import health_parts_local

@@ -1,6 +1,7 @@
 """Model workloads built on the framework (the reference's `examples/` role,
 re-designed whole-loop-jitted for TPU)."""
 
+from .common import ensemble_partition_spec, ensemble_state
 from .diffusion import (
     DiffusionParams, init_diffusion3d, init_diffusion2d,
     diffusion_step_local, make_step, make_run, make_run_deep,
@@ -18,6 +19,7 @@ from .stokes import (
 )
 
 __all__ = [
+    "ensemble_partition_spec", "ensemble_state",
     "DiffusionParams", "init_diffusion3d", "init_diffusion2d",
     "diffusion_step_local", "make_step", "make_run", "make_run_deep",
     "make_run_sr",

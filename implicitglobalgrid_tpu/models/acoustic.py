@@ -199,7 +199,8 @@ def _resolve_impl(impl):
 
 
 def make_acoustic_run(p: AcousticParams, nt_chunk: int,
-                      impl: str | None = None):
+                      impl: str | None = None,
+                      ensemble: int | None = None):
     if p.comm_every > 1:
         from ..utils.exceptions import InvalidArgumentError
 
@@ -207,16 +208,32 @@ def make_acoustic_run(p: AcousticParams, nt_chunk: int,
             f"AcousticParams(comm_every={p.comm_every}) needs the "
             "deep-halo runner: use run_acoustic or make_acoustic_run_deep "
             "(make_acoustic_run exchanges every step).")
-    impl = _resolve_impl(impl)
+    if ensemble is not None:
+        from .common import resolve_ensemble_impl
+
+        impl = resolve_ensemble_impl(impl, "acoustic")
+    else:
+        impl = _resolve_impl(impl)
     return make_state_runner(
         lambda s: acoustic_step_local(s, p, impl), (3, 3, 3, 3),
         nt_chunk=nt_chunk, key=("acoustic3d", p, impl),
         check_vma=False if impl.startswith("pallas") else None,
+        ensemble=ensemble,
     )
 
 
 def run_acoustic(state, p: AcousticParams, nt: int, *, nt_chunk: int = 100,
-                 impl: str | None = None):
+                 impl: str | None = None, ensemble: int | None = None):
+    if ensemble is not None:
+        if p.comm_every > 1:
+            from ..utils.exceptions import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                "ensemble batching supports the plain XLA leapfrog only "
+                "(comm_every > 1 is a solo-run feature).")
+        return run_chunked(
+            lambda c: make_acoustic_run(p, c, impl, ensemble=int(ensemble)),
+            state, nt, nt_chunk)
     if p.comm_every > 1:
         from ..utils.exceptions import InvalidArgumentError
 

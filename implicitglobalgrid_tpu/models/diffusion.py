@@ -343,16 +343,24 @@ def make_step(p: DiffusionParams, ndim: int = 3, impl: str | None = None):
 
 
 def make_run(p: DiffusionParams, nt_chunk: int, ndim: int = 3,
-             impl: str | None = None):
+             impl: str | None = None, ensemble: int | None = None):
     """Whole-loop runner: ONE compiled program advancing ``nt_chunk`` steps
     (`lax.fori_loop` with the halo ppermutes inline) — the TPU-first
     replacement for the reference's per-step dispatch loop. Built on the
     shared epoch-cached runner machinery (`models/common.py`); the state is
-    ``(T, Cp)`` with ``Cp`` carried through unchanged."""
-    from .common import make_state_runner
+    ``(T, Cp)`` with ``Cp`` carried through unchanged.
+
+    ``ensemble=E`` advances E scenario members per step through the SAME
+    collectives (the vmapped chunk of `make_state_runner(ensemble=)`):
+    state arrays lead with the member axis (`common.ensemble_state`),
+    per-member ``Cp``/initial-condition variants included. XLA tier."""
+    from .common import make_state_runner, resolve_ensemble_impl
 
     _reject_comm_every(p, "make_run")
-    impl = _resolve_impl(impl, ndim)
+    if ensemble is not None:
+        impl = resolve_ensemble_impl(impl, "diffusion")
+    else:
+        impl = _resolve_impl(impl, ndim)
 
     def step(state):
         T, Cp = state
@@ -362,6 +370,7 @@ def make_run(p: DiffusionParams, nt_chunk: int, ndim: int = 3,
         step, (ndim, ndim), nt_chunk=nt_chunk,
         key=("diffusion", p, impl),
         check_vma=False if impl.startswith("pallas") else None,
+        ensemble=ensemble,
     )
 
 
@@ -420,14 +429,36 @@ def make_run_deep(p: DiffusionParams, nt_chunk_super: int, ndim: int = 3):
 
 
 def run_diffusion(T, Cp, p: DiffusionParams, nt: int, *, nt_chunk: int = 100,
-                  impl: str | None = None):
+                  impl: str | None = None, ensemble: int | None = None):
     """Advance ``nt`` steps, compiling at most two chunk sizes. With
     ``p.sr`` and a bfloat16 state, routes through the stochastic-rounding
-    runner (the step counter is threaded internally)."""
+    runner (the step counter is threaded internally).
+
+    ``ensemble=E`` advances an E-member batch (``T``/``Cp`` lead with the
+    member axis — `common.ensemble_state`): one mesh, one set of
+    collectives, E trajectories per step. Plain XLA stepping only
+    (``sr``/``comm_every`` variants are solo-run features)."""
     import jax.numpy as jnp
 
+    from ..utils.exceptions import InvalidArgumentError
     from .common import run_chunked
 
+    if ensemble is not None:
+        E = int(ensemble)
+        if p.comm_every > 1 or p.sr:
+            raise InvalidArgumentError(
+                "ensemble batching supports the plain XLA step only "
+                "(comm_every > 1 and sr=True are solo-run features).")
+        if T.ndim < 2 or int(T.shape[0]) != E:
+            raise InvalidArgumentError(
+                f"ensemble={E} expects T to lead with the member axis "
+                f"(shape (E, ...)); got {tuple(T.shape)} — build the "
+                "state with models.common.ensemble_state.")
+        ndim = T.ndim - 1
+        T, Cp = run_chunked(
+            lambda c: make_run(p, c, ndim, impl, ensemble=E),
+            (T, Cp), nt, nt_chunk)
+        return T
     ndim = T.ndim
     if p.comm_every > 1:
         from ..utils.exceptions import InvalidArgumentError

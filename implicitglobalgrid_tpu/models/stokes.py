@@ -280,7 +280,8 @@ def _resolve_impl(impl):
     return resolve_pallas_impl(impl)
 
 
-def make_stokes_run(p: StokesParams, nt_chunk: int, impl: str | None = None):
+def make_stokes_run(p: StokesParams, nt_chunk: int, impl: str | None = None,
+                    ensemble: int | None = None):
     if p.comm_every > 1:
         from ..utils.exceptions import InvalidArgumentError
 
@@ -288,18 +289,36 @@ def make_stokes_run(p: StokesParams, nt_chunk: int, impl: str | None = None):
             f"StokesParams(comm_every={p.comm_every}) needs the deep-halo "
             "runner: use run_stokes or make_stokes_run_deep "
             "(make_stokes_run exchanges every iteration).")
-    impl = _resolve_impl(impl)
+    if ensemble is not None:
+        from .common import resolve_ensemble_impl
+
+        impl = resolve_ensemble_impl(impl, "stokes")
+    else:
+        impl = _resolve_impl(impl)
     return make_state_runner(
         lambda s: stokes_step_local(s, p, impl), (3,) * 8,
         nt_chunk=nt_chunk, key=("stokes3d", p, impl),
         check_vma=False if impl.startswith("pallas") else None,
+        ensemble=ensemble,
     )
 
 
 def run_stokes(state, p: StokesParams, nt: int, *, nt_chunk: int = 100,
-               impl: str | None = None):
+               impl: str | None = None, ensemble: int | None = None):
     """Run ``nt`` PT iterations (one compiled program per chunk). With
-    ``p.comm_every > 1``, routes through the deep-halo runner."""
+    ``p.comm_every > 1``, routes through the deep-halo runner.
+    ``ensemble=E`` batches E member realizations through one chunk
+    (member-stacked state, `common.ensemble_state`; plain XLA tier)."""
+    if ensemble is not None:
+        if p.comm_every > 1:
+            from ..utils.exceptions import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                "ensemble batching supports the plain XLA PT iteration "
+                "only (comm_every > 1 is a solo-run feature).")
+        return run_chunked(
+            lambda c: make_stokes_run(p, c, impl, ensemble=int(ensemble)),
+            state, nt, nt_chunk)
     if p.comm_every > 1:
         from ..utils.exceptions import InvalidArgumentError
 

@@ -147,9 +147,16 @@ def stacked_shape(local_shape) -> tuple:
 
 
 def field_partition_spec(ndim: int):
-    """PartitionSpec sharding the first ``ndim`` array axes over the mesh axes."""
+    """PartitionSpec sharding the first ``ndim`` array axes over the mesh
+    axes. Ranks beyond `NDIMS` lead with replicated (``None``) axes — the
+    ensemble/member layout (ISSUE 12): a rank-4 array is ``(member, x, y,
+    z)`` with every shard holding ALL members of its block, which is what
+    lets the checkpoint/snapshot layers round-trip ensemble state with the
+    same block keys as the solo run."""
     from jax.sharding import PartitionSpec as P
 
+    if ndim > NDIMS:
+        return P(*([None] * (ndim - NDIMS)), *AXIS_NAMES)
     return P(*AXIS_NAMES[:ndim])
 
 

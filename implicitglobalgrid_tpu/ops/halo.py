@@ -73,6 +73,7 @@ the wire format. See `ops.precision.wire_format_for`.
 
 from __future__ import annotations
 
+import contextlib
 
 import numpy as np
 
@@ -89,7 +90,7 @@ from .wire import schema_for_fields, slab_schema
 __all__ = ["update_halo", "local_update_halo", "free_update_halo_caches",
            "halo_may_use_pallas", "resolve_halo_coalesce", "halo_comm_plan",
            "exchange_recv_slabs", "exchange_recv_slabs_multi",
-           "DEFAULT_DIMS_ORDER"]
+           "force_xla_exchange", "DEFAULT_DIMS_ORDER"]
 
 # Reference default `dims=(3,1,2)` (1-based: z, x, y — update_halo.jl:29).
 DEFAULT_DIMS_ORDER = (2, 0, 1)
@@ -179,12 +180,35 @@ def _dim_meta(gg, dim: int):
 # (CPU) so the kernel path is exercised by the emulated-mesh test suite.
 _FORCE_PALLAS_WRITE_INTERPRET = False
 
+# Trace-scoped kernel-tier override: the ensemble runner pins its vmapped
+# step to the pure-XLA exchange (every XLA op has a vmap batching rule;
+# the Pallas halo kernels' batching is unvalidated hardware territory).
+_FORCE_XLA_TIER = False
+
+
+@contextlib.contextmanager
+def force_xla_exchange():
+    """Context manager pinning `local_update_halo` to the pure-XLA tier
+    (no Pallas halo kernels) for the duration of a TRACE. Used by
+    `models.common.make_state_runner(ensemble=...)` around its vmapped
+    step: the exchange's slices/permutes/updates all batch by jax rule,
+    while a Pallas kernel launched under vmap would lean on `pallas_call`
+    batching this repo has never validated on hardware. The flag is
+    consulted at trace time by every kernel-tier gate below."""
+    global _FORCE_XLA_TIER
+    prev = _FORCE_XLA_TIER
+    _FORCE_XLA_TIER = True
+    try:
+        yield
+    finally:
+        _FORCE_XLA_TIER = prev
+
 
 def _pallas_write_mode(gg, dim, shape, hw):
     """(use_kernel, interpret) for the halo unpack along ``dim``."""
     from .pallas_halo import halo_write_supported
 
-    if not halo_write_supported(shape, dim, hw):
+    if _FORCE_XLA_TIER or not halo_write_supported(shape, dim, hw):
         return False, False
     if _FORCE_PALLAS_WRITE_INTERPRET:
         return True, True
@@ -195,6 +219,8 @@ def _pallas_tier_enabled(gg, shape, dims_order) -> bool:
     """Shared gate for the whole-exchange Pallas kernels (self-exchange and
     combined one-pass): default order, 3-D, TPU with all per-dim flags on
     (the kernels cover every dim at once), or the test force flag."""
+    if _FORCE_XLA_TIER:
+        return False
     if tuple(dims_order) != DEFAULT_DIMS_ORDER or len(shape) != 3:
         return False
     return _FORCE_PALLAS_WRITE_INTERPRET or (
@@ -472,7 +498,7 @@ def _coalesced_pallas_mode(gg, dim, shapes, hws_dim):
     ``dim`` — the multi-field analog of `_pallas_write_mode`."""
     from .pallas_halo import multi_write_supported
 
-    if not multi_write_supported(shapes, dim, hws_dim):
+    if _FORCE_XLA_TIER or not multi_write_supported(shapes, dim, hws_dim):
         return False, False
     if _FORCE_PALLAS_WRITE_INTERPRET:
         return True, True
@@ -802,7 +828,8 @@ class _SigField:
         self.ndim = len(self.shape)
 
 
-def _plan_from_sig(gg, sig, dims_order, coalesce, wire) -> dict:
+def _plan_from_sig(gg, sig, dims_order, coalesce, wire,
+                   ensemble=None) -> dict:
     """Static comm accounting for one exchange signature: collective
     counts and bytes-on-wire derived purely from shapes/overlaps/wire
     dtype — no tracing, no device work (the TPU analog of the reference's
@@ -819,7 +846,24 @@ def _plan_from_sig(gg, sig, dims_order, coalesce, wire) -> dict:
     byte). ``wire_bytes`` sums the payload over every source->dest
     link of the permute (all shards), both directions;
     ``local_copy_bytes`` counts self-neighbor slab swaps that never touch
-    the interconnect."""
+    the interconnect.
+
+    ``ensemble`` prices the ENSEMBLE axis (ISSUE 12): an E-member chunk
+    vmaps the member axis over the step, so jax's collective batching
+    keeps the ppermute COUNT identical while every payload (and every
+    self-neighbor local copy) carries E members' slabs — bytes x E,
+    launches flat in E. The schema's ``members`` field is the single
+    byte source, so quantized payloads price E x the per-(member, slab)
+    scale tails exactly as `WireSchema.payload_bytes` ships them."""
+    E = 1
+    if ensemble is not None:
+        E = int(ensemble)
+        if E < 1:
+            # loud, like every runner-side layer: a silently clamped plan
+            # would hand a tuner valid-looking solo numbers for a
+            # configuration the runtime rejects
+            raise InvalidArgumentError(
+                f"halo_comm_plan: ensemble must be >= 1; got {ensemble}.")
     fields = [_SigField(shape, dt) for (shape, dt, _) in sig]
     hws = [tuple(int(h) for h in hw) for (_, _, hw) in sig]
 
@@ -861,21 +905,23 @@ def _plan_from_sig(gg, sig, dims_order, coalesce, wire) -> dict:
             # byte incl. quantized slabs + their `SCALE_BYTES` scale tail
             schema = schema_for_fields(
                 dim, [fields[i].shape for i in g],
-                [hws[i][dim] for i in g], f0.dtype, fmt)
+                [hws[i][dim] for i in g], f0.dtype, fmt, members=E)
             add_wire(dim, schema.payload_bytes, schema.wire_key, npairs)
         for i, f in enumerate(fields):
             if i in in_group or not _dim_exchanges(gg, f.shape, hws[i], dim):
                 continue
             if D == 1:  # periodic self-neighbor: local slab swap, no wire
-                local_bytes += 2 * slab_cells(i, dim) * f.dtype.itemsize
+                local_bytes += 2 * slab_cells(i, dim) * f.dtype.itemsize * E
                 continue
             fmt = wire_format_for(f.dtype, wire, dim)
             wd = np.dtype(fmt.dtype if fmt is not None else f.dtype)
-            add_wire(dim, slab_cells(i, dim) * wd.itemsize, str(wd), npairs)
+            add_wire(dim, slab_cells(i, dim) * wd.itemsize * E, str(wd),
+                     npairs)
     return {
         "fields": len(fields),
         "coalesce": bool(coalesce),
         "wire_dtype": None if wire is None else str(wire),
+        "ensemble": E,
         "axes": axes,
         "ppermutes": sum(r["ppermutes"] for r in axes.values()),
         "wire_bytes": sum(r["wire_bytes"] for r in axes.values()),
@@ -936,7 +982,8 @@ def _stacked_sig(gg, fs) -> tuple:
     )
 
 
-def halo_comm_plan(*fields, dims=None, coalesce=None, wire_dtype=None) -> dict:
+def halo_comm_plan(*fields, dims=None, coalesce=None, wire_dtype=None,
+                   ensemble=None) -> dict:
     """Static bytes-on-wire / collective-count plan for an `update_halo`
     call with these stacked fields — derived from shapes, overlaps, and
     the wire dtype alone; nothing is compiled or dispatched (zero device
@@ -944,10 +991,17 @@ def halo_comm_plan(*fields, dims=None, coalesce=None, wire_dtype=None) -> dict:
     `Field`, ``(A, hw)`` tuples, pytrees) and anything with
     ``shape``/``dtype`` (e.g. `jax.ShapeDtypeStruct`) works.
 
-    Returns ``{fields, coalesce, wire_dtype, axes: {axis: {ppermutes,
-    wire_bytes, by_dtype}}, ppermutes, wire_bytes, local_copy_bytes}``.
-    `update_halo` charges exactly this plan to the telemetry registry
-    (``igg_halo_*`` counters) on every call."""
+    ``ensemble=E`` prices the exchange inside an E-member ensemble chunk
+    (`models.common.make_state_runner(ensemble=E)`): the PHYSICAL field
+    shapes stay what you pass here (no member axis — the plan describes
+    one member's geometry) while every payload multiplies by E behind
+    the SAME ppermute pairs (jax's collective batching under vmap;
+    ``ppermutes`` is flat in E by construction).
+
+    Returns ``{fields, coalesce, wire_dtype, ensemble, axes: {axis:
+    {ppermutes, wire_bytes, by_dtype}}, ppermutes, wire_bytes,
+    local_copy_bytes}``. `update_halo` charges exactly this plan to the
+    telemetry registry (``igg_halo_*`` counters) on every call."""
     check_initialized()
     gg = global_grid()
     dims_order = _normalize_dims_order(dims)
@@ -955,7 +1009,8 @@ def halo_comm_plan(*fields, dims=None, coalesce=None, wire_dtype=None) -> dict:
     sig = _stacked_sig(gg, fs)
     return _plan_from_sig(gg, sig, dims_order,
                           resolve_halo_coalesce(coalesce),
-                          resolve_wire_dtype(wire_dtype))
+                          resolve_wire_dtype(wire_dtype),
+                          ensemble=ensemble)
 
 
 def update_halo(*fields, dims=None, coalesce=None, wire_dtype=None):

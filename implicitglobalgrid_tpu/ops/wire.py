@@ -67,6 +67,17 @@ class WireSchema:
     exact wire); ``layout`` is ``"slab"`` or ``"flat"`` (see module
     docstring). Frozen and hashable — derived once per exchange signature
     and shared by the pack, the unpack, and every byte-accounting layer.
+
+    ``members`` is the ENSEMBLE axis (ISSUE 12): an ensemble chunk
+    advances E scenario members per step by ``vmap``-ing the member axis
+    over the step program, and jax's collective batching rule turns each
+    per-member ppermute into ONE ppermute whose payload carries every
+    member's slabs — the same pair count, E x the bytes. The live
+    `pack`/`unpack` therefore stay PER-MEMBER programs (the vmap batches
+    them); ``members`` exists so the byte accounting (`payload_bytes`)
+    prices the batched payload the compiler actually ships — including
+    E x the per-slab scale tails of a quantized wire, one f32 scale per
+    (member, slab) in the same scales-in-band layout.
     """
 
     dim: int
@@ -74,6 +85,7 @@ class WireSchema:
     state_dtype: str       # numpy dtype name
     fmt: object = None     # WireFormat | None
     layout: str = "slab"
+    members: int = 1       # ensemble members riding one payload
 
     # -- derived geometry ---------------------------------------------------
 
@@ -102,11 +114,17 @@ class WireSchema:
         """EXACT bytes of one direction's packed payload — the number every
         wire-reasoning layer prices (`halo_comm_plan` by-dtype rows,
         `predict_step` per-axis pricing, `exchange_contract` wire-byte
-        equality against the compiled program)."""
+        equality against the compiled program). With ``members`` > 1 the
+        per-member payload (quantized slabs + their per-slab scales
+        included) multiplies by the member count — the vmap-batched
+        buffer one ppermute carries."""
         if self.is_quant:
-            return (sum(quant_slab_bytes(c, self.fmt) for c in self.cells)
-                    + SCALE_BYTES * self.n_slabs)
-        return sum(self.cells) * int(self.wire_dtype.itemsize)
+            per_member = (sum(quant_slab_bytes(c, self.fmt)
+                              for c in self.cells)
+                          + SCALE_BYTES * self.n_slabs)
+        else:
+            per_member = sum(self.cells) * int(self.wire_dtype.itemsize)
+        return per_member * max(1, int(self.members))
 
     @property
     def wire_key(self) -> str:
@@ -214,22 +232,28 @@ def _slab_layout_ok(dim: int, shapes) -> bool:
     return True
 
 
-def slab_schema(dim: int, shapes, state_dtype, fmt=None) -> WireSchema:
+def slab_schema(dim: int, shapes, state_dtype, fmt=None,
+                members: int = 1) -> WireSchema:
     """Derive the canonical schema for one (axis, dtype group) from the
     slab signature alone. ``fmt`` is the resolved `WireFormat` for this
-    axis (`precision.wire_format_for`), or ``None`` for exact wire."""
+    axis (`precision.wire_format_for`), or ``None`` for exact wire;
+    ``members`` is the ensemble member count riding the payload (byte
+    accounting only — the live pack stays per-member under vmap)."""
     shapes = tuple(tuple(int(v) for v in s) for s in shapes)
     if not shapes:
         raise InvalidArgumentError("slab_schema needs at least one slab.")
+    if int(members) < 1:
+        raise InvalidArgumentError(
+            f"slab_schema: members must be >= 1; got {members}.")
     quant = fmt is not None and fmt.is_quant
     layout = "flat" if quant or not _slab_layout_ok(dim, shapes) else "slab"
     return WireSchema(dim=int(dim), shapes=shapes,
                       state_dtype=str(np.dtype(state_dtype)), fmt=fmt,
-                      layout=layout)
+                      layout=layout, members=int(members))
 
 
 def schema_for_fields(dim: int, shapes, hws, state_dtype,
-                      fmt=None) -> WireSchema:
+                      fmt=None, members: int = 1) -> WireSchema:
     """`slab_schema` from FIELD shapes (local blocks) instead of slab
     shapes: the send slab of a field along ``dim`` is its cross extents x
     the halowidth. The one geometry rule (`ops.halo`: slab width = hw)
@@ -239,4 +263,4 @@ def schema_for_fields(dim: int, shapes, hws, state_dtype,
         s = list(int(v) for v in shp)
         s[dim] = int(hw)
         slab_shapes.append(tuple(s))
-    return slab_schema(dim, slab_shapes, state_dtype, fmt)
+    return slab_schema(dim, slab_shapes, state_dtype, fmt, members=members)

@@ -191,6 +191,28 @@ class ResilientRun:
         self.step_local = step_local
         self.state = state
         self.names = list(state)
+        self.ensemble = (None if spec.ensemble is None
+                         else int(spec.ensemble))
+        if self.ensemble is not None:
+            if self.ensemble < 1:
+                raise InvalidArgumentError(
+                    f"RunSpec.ensemble must be >= 1; got {spec.ensemble}.")
+            for k, v in state.items():
+                if v.ndim < 2 or int(v.shape[0]) != self.ensemble:
+                    raise InvalidArgumentError(
+                        f"ensemble={self.ensemble} expects every field to "
+                        f"lead with the member axis (shape (E, ...)); "
+                        f"field {k!r} has shape {tuple(v.shape)} — build "
+                        "the state with models.common.ensemble_state.")
+        # member-splice recovery (ensemble only): after a PARTIAL guard
+        # trip the healthy members' committed chunk output (their slices
+        # only) is pinned here keyed by the tripped boundary's step, and
+        # re-spliced over the replay when it reaches that step again —
+        # one diverging realization rolls back alone, the rest keep
+        # their trajectory. A dict (not a single slot) so a second trip
+        # at a DIFFERENT boundary (chunk-shrink escalation mid-replay)
+        # cannot silently drop an earlier boundary's pin.
+        self._pins: dict = {}
         self.guard = spec.guard if spec.guard is not None else GuardConfig()
         self.policy = (spec.policy if spec.policy is not None
                        else RecoveryPolicy())
@@ -206,6 +228,12 @@ class ResilientRun:
                 raise InvalidArgumentError(
                     f"Fault {f} is outside the run's step range "
                     f"[0, {self.nt}).")
+            if isinstance(f, ProcessLoss) and self.ensemble is not None:
+                raise InvalidArgumentError(
+                    "ProcessLoss (elastic restart) is not supported for "
+                    "ensemble runs yet: the elastic redistribution "
+                    "reasons over the 3 spatial axes and would remap the "
+                    "member axis.")
             if isinstance(f, NaNPoke):
                 if f.name not in state:
                     raise InvalidArgumentError(
@@ -502,14 +530,25 @@ class ResilientRun:
         for f in self.pending:
             if isinstance(f, (NaNPoke, ProcessLoss)) and step < f.step < nb:
                 nb = f.step
+        pending_pins = [s for s in self._pins if s > step]
+        if pending_pins:
+            # member-splice replay in flight: land exactly on the NEXT
+            # pinned boundary so the healthy members' pinned chunk output
+            # can be re-spliced there (an overshooting boundary would
+            # strand it)
+            nb = min(nb, min(pending_pins))
         n = nb - step
         state, names, spec = self.state, self.names, self.spec
 
-        ndims = tuple(state[k].ndim for k in names)
-        sizes = [int(np.prod(state[k].shape)) for k in names]
+        E = self.ensemble
+        ndims = tuple(state[k].ndim - (1 if E else 0) for k in names)
+        sizes = [int(np.prod(state[k].shape[1:] if E
+                             else state[k].shape)) for k in names]
         misses0 = runner_cache_misses() if self.watch is not None else 0.0
         t_build0 = time.monotonic()
         if self.reducers:
+            import jax
+
             from ..io.reducers import build_reducer_plan, \
                 make_reduced_post_chunk
             from ..models.common import make_state_runner
@@ -517,20 +556,26 @@ class ResilientRun:
             # rebuilt per boundary (cheap host work): the ownership
             # geometry follows the LIVE decomposition — an elastic restart
             # changes it — and the plan signature joins the runner key, so
-            # stale compiled hooks can never serve
-            plan = build_reducer_plan(self.reducers, names, state)
+            # stale compiled hooks can never serve. The plan reasons over
+            # PER-MEMBER geometry (the reducer hook runs vmapped, one
+            # segment set per member behind the same psum).
+            plan_state = state if not E else {
+                k: jax.ShapeDtypeStruct(tuple(v.shape[1:]), v.dtype)
+                for k, v in state.items()}
+            plan = build_reducer_plan(self.reducers, names, plan_state)
             runner = make_state_runner(
                 self._step_tuple, ndims, nt_chunk=n,
                 key=None if spec.key is None
                 else (spec.key, "resilient-io", plan.signature),
                 check_vma=spec.check_vma, unroll=spec.unroll,
-                post_chunk=make_reduced_post_chunk(names, plan))
+                post_chunk=make_reduced_post_chunk(names, plan),
+                ensemble=E)
         else:
             plan = None
             runner = make_guarded_runner(
                 self._step_tuple, ndims, nt_chunk=n,
                 key=None if spec.key is None else (spec.key, "resilient"),
-                check_vma=spec.check_vma, unroll=spec.unroll)
+                check_vma=spec.check_vma, unroll=spec.unroll, ensemble=E)
         t_built = time.monotonic()
         if spec.audit and n not in self._audited_ns \
                 and self._audit_fail_counts.get(n, 0) < 2:
@@ -545,7 +590,7 @@ class ResilientRun:
                 rep_audit = audit_chunk_program(
                     runner, tuple(state[k] for k in names), names=names,
                     reducer_floats=plan.length if plan is not None else 0,
-                    lints=spec.audit_lints)
+                    lints=spec.audit_lints, ensemble=E)
                 observe_audit(rep_audit,
                               audit_s=time.monotonic() - t_built)
                 self._audited_ns.add(n)
@@ -565,22 +610,42 @@ class ResilientRun:
         out = runner(*(state[k] for k in names))
         # tiny replicated fetch = the chunk drain; with reducers the
         # vector carries [health | reducer segments] from ONE psum
+        # (ensemble: an (E, 2N+R) matrix — per-member rows, one psum)
         vec = np.asarray(out[-1])
         t_done = time.monotonic()
-        rep = report_from_stats(vec[:2 * len(names)], names, sizes,
-                                self.guard, chunk=self.chunk_idx,
-                                step_begin=step, step_end=nb)
+        nh = 2 * len(names)
+        if E:
+            from .health import ensemble_reports_from_stats
+
+            member_reps = ensemble_reports_from_stats(
+                vec[:, :nh], names, sizes, self.guard,
+                chunk=self.chunk_idx, step_begin=step, step_end=nb)
+            self.reports.extend(member_reps)
+            tripped = [r.member for r in member_reps if not r.ok]
+            reasons = [f"{reason}@m{r.member}" for r in member_reps
+                       for reason in r.reasons]
+            ok = not tripped
+            rep = member_reps[0]  # chunk-level anchor (chunk/step fields)
+            from ..telemetry.hooks import observe_member_health
+
+            observe_member_health(member_reps)
+        else:
+            rep = report_from_stats(vec[:nh], names, sizes,
+                                    self.guard, chunk=self.chunk_idx,
+                                    step_begin=step, step_end=nb)
+            self.reports.append(rep)
+            tripped, reasons, ok = None, list(rep.reasons), rep.ok
         self.chunk_idx += 1
-        self.reports.append(rep)
         record_health_event("chunks")
         # exec_s covers dispatch through the stats fetch (= the chunk
         # drain); a chunk right after a runner-cache miss also pays the
         # XLA compile inside it — run_report flags those chunks as cold
         record_event("chunk", chunk=rep.chunk, step_begin=step,
-                     step_end=nb, n=n, ok=rep.ok,
-                     reasons=list(rep.reasons),
+                     step_end=nb, n=n, ok=ok,
+                     reasons=reasons,
                      build_s=t_built - t_build0,
-                     exec_s=t_done - t_exec0)
+                     exec_s=t_done - t_exec0,
+                     **({"members_tripped": tripped} if E else {}))
         if self.watch is not None:
             # live drift detection: pure host arithmetic per boundary (a
             # cold chunk — its dispatch paid the XLA compile after a
@@ -594,17 +659,28 @@ class ResilientRun:
         if plan is not None:
             from ..telemetry.hooks import observe_reducers
 
-            values = plan.decode(vec[2 * len(names):])
-            observe_reducers(nb, values, ok=rep.ok)
+            if E:
+                # each scenario streams its own probes/stats: one decoded
+                # segment set per member, labeled "<label>[m<member>]"
+                values = {}
+                for m in range(E):
+                    for label, v in plan.decode(vec[m, nh:]).items():
+                        values[f"{label}[m{m}]"] = v
+            else:
+                values = plan.decode(vec[nh:])
+            observe_reducers(nb, values, ok=ok)
             if spec.on_reduce is not None:
                 spec.on_reduce(nb, values)
         if spec.on_report is not None:
-            spec.on_report(rep)
+            for r in (member_reps if E else (rep,)):
+                spec.on_report(r)
 
-        if rep.ok:
+        if ok:
             self.state = dict(zip(names, out[:-1]))
             self.step = nb
             self.retries = 0
+            if self.step in self._pins:
+                self._splice_pin(self.step, self._pins.pop(self.step))
             # cadence saves, plus the TERMINAL state: without the latter a
             # run whose nt is off-cadence could never be resumed from its
             # own end
@@ -623,17 +699,18 @@ class ResilientRun:
         # --- guard tripped: bounded-retry rollback ------------------------
         record_health_event("guard_trips")
         self.retries += 1
-        record_event("guard_trip", step_end=nb, reasons=list(rep.reasons),
-                     retries=self.retries)
+        record_event("guard_trip", step_end=nb, reasons=reasons,
+                     retries=self.retries,
+                     **({"members": tripped} if E else {}))
         if self.slots is None:
             raise ResilienceError(
                 f"Health guard tripped at step {nb} "
-                f"({', '.join(rep.reasons)}) and no checkpoint_dir is "
+                f"({', '.join(reasons)}) and no checkpoint_dir is "
                 "configured — cannot roll back.")
         if self.retries > self.policy.max_retries:
             raise ResilienceError(
                 f"Health guard tripped {self.retries} consecutive times "
-                f"at step {nb} ({', '.join(rep.reasons)}); retry budget "
+                f"at step {nb} ({', '.join(reasons)}); retry budget "
                 f"({self.policy.max_retries}) exhausted.")
         if self.policy.backoff_s:
             time.sleep(self.policy.backoff_s * 2 ** (self.retries - 1))
@@ -648,6 +725,37 @@ class ResilientRun:
                 self.policy.on_escalate({"retries": self.retries,
                                          "nt_chunk": self.cur_chunk,
                                          "step": step})
+        if E and tripped:
+            # PARTIAL trip: recovery keys on the member index. Pin the
+            # healthy members' committed chunk output (their slices
+            # only); the whole batch replays from the last-good save
+            # (members are independent under vmap, so the replay IS each
+            # tripped member's solo recompute), and at the pinned
+            # boundary `_splice_pin` re-asserts the healthy members'
+            # pinned state — surviving realizations keep their committed
+            # trajectory even if the replay were to diverge; only the
+            # tripped member's rolls back. An all-members trip leaves no
+            # healthy set and falls through to the classic full
+            # rollback (any stale pin at this boundary is dropped).
+            healthy = [m for m in range(E) if m not in tripped]
+            prior = self._pins.get(nb)
+            if prior is not None:
+                # a second trip at the SAME boundary: members healthy in
+                # BOTH attempts stay pinned; newly tripped ones drop out
+                healthy = [m for m in healthy if m in prior["healthy"]]
+            if healthy:
+                import jax.numpy as jnp
+
+                idx = jnp.asarray(healthy)
+                self._pins[nb] = {
+                    "healthy": healthy,
+                    "state": {k: v[idx]
+                              for k, v in zip(names, out[:-1])}}
+                record_health_event("member_rollbacks")
+                record_event("member_rollback", members=tripped,
+                             pinned=healthy, step_end=nb)
+            else:
+                self._pins.pop(nb, None)
         self.state, self.step, fellback = self.slots.restore()
         record_health_event("rollbacks")
         record_health_event("restores")
@@ -655,6 +763,23 @@ class ResilientRun:
             record_health_event("restore_fallbacks")
         record_event("rollback", to_step=self.step, fallback=fellback,
                      retries=self.retries)
+
+    def _splice_pin(self, at_step: int, pin: dict) -> None:
+        """Finish a member-splice replay: overwrite the healthy members'
+        slices of the replayed state with their PINNED chunk output (the
+        committed trajectory; only those members' slices were kept). The
+        replay is deterministic, so this is numerically a no-op — it is
+        the isolation GUARANTEE (a healthy realization can never be
+        perturbed by a neighbor's rollback), and it runs before the
+        commit's cadence save so checkpoints hold the spliced state."""
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(pin["healthy"])
+        self.state = {
+            k: v.at[idx].set(pin["state"][k])
+            for k, v in self.state.items()}
+        self._record_event("member_splice", members=pin["healthy"],
+                           step=at_step)
 
     def close(self) -> None:
         """Release the run's resources (metrics endpoint, snapshot-writer
@@ -704,6 +829,21 @@ def run_resilient(step_local, state: dict, nt: int, *,
     exact step boundaries; rollback recomputes from the last good save, so
     a recovered run's final state is bit-identical to an uninterrupted one
     (asserted end-to-end in `tests/test_resilience.py`).
+
+    ``ensemble=E`` batches E scenario members through the one supervised
+    run (ISSUE 12): every state array leads with the member axis (build
+    with `models.common.ensemble_state`; ``step_local`` stays the
+    PER-MEMBER step — the runner vmaps it), the chunk's collective count
+    stays flat in E (one E x-payload ppermute pair per axis, one
+    ``f32[E·(2N+R)]`` guard psum), and the guard trips PER MEMBER: a
+    partial trip pins the healthy members' committed chunk output,
+    replays the batch from the last-good save and re-splices the pinned
+    members at the boundary (``member_rollback``/``member_splice``
+    events, ``member_rollbacks`` health counter) — one diverging
+    realization rolls back alone. Reducer values stream per member
+    (labels suffixed ``[m<member>]``); `HealthReport.member` carries the
+    member index (E reports per chunk). Elastic restart (`ProcessLoss`)
+    is not supported under ensemble yet.
 
     Output pipeline (the `implicitglobalgrid_tpu/io/` subsystem —
     O(shard) per process, never a gather): ``snapshot_dir`` enables ASYNC

@@ -27,7 +27,8 @@ import math
 from dataclasses import dataclass
 
 __all__ = ["GuardConfig", "HealthReport", "make_guarded_runner",
-           "health_stats_local", "health_parts_local", "report_from_stats"]
+           "health_stats_local", "health_parts_local", "report_from_stats",
+           "ensemble_reports_from_stats"]
 
 
 @dataclass(frozen=True)
@@ -57,13 +58,18 @@ class HealthReport:
     exact up to 2^24, saturating precision beyond — the trip condition is
     ``> 0`` either way); ``rms`` is the stacked-layout RMS per field;
     ``reasons`` names every tripped guard (``"nonfinite:T"``,
-    ``"rms:T"``); ``ok`` is ``not reasons``."""
+    ``"rms:T"``); ``ok`` is ``not reasons``. In an ENSEMBLE run
+    (ISSUE 12) each chunk yields one report PER MEMBER — ``member`` is
+    the member index (``None`` outside ensemble mode), and the guard
+    trips per member: one diverging realization rolls back alone
+    (`runtime/driver.py` member-splice recovery)."""
     chunk: int
     step_begin: int
     step_end: int
     nonfinite: dict
     rms: dict
     reasons: tuple = ()
+    member: int | None = None
 
     @property
     def ok(self) -> bool:
@@ -101,22 +107,28 @@ def health_stats_local(state) -> "jax.Array":  # noqa: F821
 
 def make_guarded_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
                         check_vma: bool | None = None,
-                        unroll: int | None = None):
+                        unroll: int | None = None,
+                        ensemble: int | None = None):
     """`models.common.make_state_runner` with the health probe fused into
     the chunk: the compiled program is ``state -> (*state, stats_vec)``.
     ``key`` namespaces the runner cache separately from any unguarded
-    runner of the same step function."""
+    runner of the same step function. With ``ensemble=E`` the probe is
+    vmapped over the member axis and the stats vector becomes
+    ``f32[E, 2N]`` — still exactly ONE psum per chunk boundary
+    (`f32[E·2N]` cells on the wire), with per-member verdicts
+    (`ensemble_reports_from_stats`)."""
     from ..models.common import make_state_runner
 
     return make_state_runner(
         step_local, state_ndims, nt_chunk=nt_chunk,
         key=None if key is None else (key, "igg_health_guard"),
-        check_vma=check_vma, unroll=unroll, post_chunk=health_stats_local)
+        check_vma=check_vma, unroll=unroll, post_chunk=health_stats_local,
+        ensemble=ensemble)
 
 
 def report_from_stats(vec, names, sizes, guard: GuardConfig, *,
-                      chunk: int, step_begin: int, step_end: int
-                      ) -> HealthReport:
+                      chunk: int, step_begin: int, step_end: int,
+                      member: int | None = None) -> HealthReport:
     """Build the host-side `HealthReport` from the fetched stats vector.
     ``sizes`` are the stacked cell counts per field (RMS denominator)."""
     nonfinite, rms, reasons = {}, {}, []
@@ -135,4 +147,16 @@ def report_from_stats(vec, names, sizes, guard: GuardConfig, *,
             reasons.append(f"rms:{name}")
     return HealthReport(chunk=chunk, step_begin=step_begin,
                         step_end=step_end, nonfinite=nonfinite, rms=rms,
-                        reasons=tuple(reasons))
+                        reasons=tuple(reasons), member=member)
+
+
+def ensemble_reports_from_stats(mat, names, sizes, guard: GuardConfig, *,
+                                chunk: int, step_begin: int, step_end: int
+                                ) -> list:
+    """Per-member `HealthReport`s from the ensemble chunk's ``(E, 2N)``
+    stats matrix — one guard verdict PER MEMBER behind the chunk's single
+    psum. ``sizes`` are the PER-MEMBER stacked cell counts."""
+    return [report_from_stats(mat[m], names, sizes, guard, chunk=chunk,
+                              step_begin=step_begin, step_end=step_end,
+                              member=m)
+            for m in range(len(mat))]

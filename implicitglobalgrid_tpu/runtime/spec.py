@@ -40,6 +40,10 @@ class RunSpec:
     - live metrics endpoint: ``metrics_port``, ``healthz_max_age_s``
     - perf oracle: ``perf_model``, ``perf_window``, ``perf_zmax``
     - static analysis: ``audit``, ``audit_lints``
+    - ensemble axis: ``ensemble`` (E scenario members batched through one
+      chunk program; every state array leads with the member axis — build
+      with `models.common.ensemble_state` — and the guard trips per
+      member)
     """
 
     nt_chunk: int = 100
@@ -66,6 +70,7 @@ class RunSpec:
     perf_zmax: float = 4.0
     audit: bool = False
     audit_lints: Any = None
+    ensemble: int | None = None
 
     def to_json(self) -> dict:
         """JSON-able summary of the NON-DEFAULT, serializable knobs (for

@@ -203,20 +203,42 @@ BUILTIN_MODELS = {
 }
 
 
-def builtin_setup(model: str, dtype: str = "float32"):
+def builtin_setup(model: str, dtype: str = "float32",
+                  ensemble: int | None = None, perturb: float = 0.0):
     """A `JobSpec.setup` callable for a built-in model family — what
     `tools jobs submit` builds from a JSON job description. The callable
-    runs at ADMISSION, under the job's own grid."""
+    runs at ADMISSION, under the job's own grid.
+
+    ``ensemble=E`` makes the job a BATCHED one (ISSUE 12): the state is
+    stacked E members deep along a new leading axis
+    (`models.common.ensemble_state`; ``perturb`` ramps member m's initial
+    state by ``1 + perturb·m`` — E parameter variants of one scenario),
+    and the step function stays the per-member local step — pair it with
+    ``RunSpec(ensemble=E)`` so the scheduler's `ResilientRun` vmaps the
+    chunk and trips the guard per member. One admitted job then serves E
+    scenario users through one set of collectives, with per-member gauges
+    in the job's scoped registry (`hooks.observe_member_health`)."""
     if model not in BUILTIN_MODELS:
         raise InvalidArgumentError(
             f"Unknown model {model!r}; available: "
             f"{sorted(BUILTIN_MODELS)}.")
+    if ensemble is not None and int(ensemble) < 1:
+        raise InvalidArgumentError(
+            f"builtin_setup: ensemble must be >= 1; got {ensemble}.")
     import numpy as np
 
     dt = np.dtype(dtype).type
 
     def setup():
-        return BUILTIN_MODELS[model](dt)
+        step, state = BUILTIN_MODELS[model](dt)
+        if ensemble is not None:
+            from ..models.common import ensemble_state
 
-    setup.__qualname__ = f"builtin_setup({model!r}, {dtype!r})"
+            state = ensemble_state(state, int(ensemble), perturb=perturb)
+        return step, state
+
+    setup.__qualname__ = (
+        f"builtin_setup({model!r}, {dtype!r}"
+        + (f", ensemble={int(ensemble)}" if ensemble is not None else "")
+        + ")")
     return setup

@@ -449,6 +449,17 @@ class MeshScheduler:
             job.scope, step=job.step, slice_s=slice_s, wait_s=wait_s,
             perf_step_s=perf_step_s, perf_ratio=perf_ratio,
             audit_findings=max(0.0, findings))
+        # batched (ensemble) jobs: mirror the LAST chunk's per-member
+        # guard verdicts into this job's scoped registry — the global
+        # igg_member_* series flap between tenants exactly like the perf
+        # gauges; the job-labeled copies are the per-scenario surface an
+        # operator watches
+        E = getattr(job.spec.run, "ensemble", None)
+        if ran_chunk and E:
+            members = job.run.reports[-int(E):]
+            if len(members) == int(E) and all(
+                    r.member is not None for r in members):
+                hooks.observe_member_health(members, scope=job.scope)
         self._log("slice", job=job.name, slice=self.slices - 1,
                   step=job.step, dur_s=slice_s, wait_s=wait_s,
                   policy=self.policy.name)

@@ -187,6 +187,7 @@ def _measure_axis_link(gg, dim: int, small_bytes: int, large_bytes: int,
 
 def calibrate_machine(path=None, *, elems_per_device: int = 1 << 18,
                       link_bytes=(1 << 13, 1 << 20), c1: int = 4,
+                      ensemble: int | None = None,
                       profile_meta: dict | None = None) -> MachineProfile:
     """Measure this mesh's machine profile (milliseconds of measured
     windows; wall clock is dominated by the handful of per-shape XLA
@@ -201,6 +202,13 @@ def calibrate_machine(path=None, *, elems_per_device: int = 1 << 18,
     Axes with a single non-periodic shard carry no wire and are profiled
     as the mean of the measured axes when the model asks.
 
+    ``ensemble=E`` calibrates the link fit in the E-member payload
+    regime (ISSUE 12): the two fitted payload sizes scale by E — the
+    batched exchange ships E x the slab bytes behind the same ppermute
+    pair, so an ensemble-sized fit measures the bandwidth plateau those
+    payloads actually ride instead of extrapolating from solo slabs. The
+    member count is recorded in the profile's ``meta``.
+
     With ``path``, the profile is also persisted as JSON
     (`save_machine_profile` / `load_machine_profile`). Returns the
     `MachineProfile` (``source="calibrated"``)."""
@@ -212,6 +220,14 @@ def calibrate_machine(path=None, *, elems_per_device: int = 1 << 18,
         raise InvalidArgumentError(
             f"calibrate_machine: link_bytes must be (small, large) with "
             f"small < large; got {tuple(link_bytes)}.")
+    if ensemble is not None:
+        E = int(ensemble)
+        if E < 1:
+            raise InvalidArgumentError(
+                f"calibrate_machine: ensemble must be >= 1; got "
+                f"{ensemble}.")
+        link_bytes = (int(link_bytes[0]) * E, int(link_bytes[1]) * E)
+        profile_meta = dict(profile_meta or {}, ensemble=E)
 
     t0 = time.time()
     membw = _measure_membw_gbps(gg, elems_per_device, c1)

@@ -22,7 +22,7 @@ __all__ = ["note_runner_cache", "account_halo_exchange",
            "note_metrics_server_port", "observe_audit",
            "note_scheduler_heartbeat", "note_queue_depth", "job_gauges",
            "observe_job_slice", "clear_scheduler_heartbeat",
-           "note_job_transition"]
+           "note_job_transition", "observe_member_health"]
 
 # Metric family names (the exported contract; see docs/observability.md).
 RUNNER_CACHE = "igg_runner_cache_total"
@@ -58,6 +58,16 @@ JOB_PERF_RATIO = "igg_job_perf_model_ratio"
 JOB_AUDIT_FINDINGS = "igg_job_audit_findings_total"
 JOB_SLICE_SECONDS = "igg_job_slice_seconds"
 JOB_WAIT_SECONDS = "igg_job_wait_seconds"
+# ensemble axis (ISSUE 12): per-member guard verdicts as labeled series
+# (the igg_job_* twins are the scheduler's per-tenant scoped mirrors —
+# distinct family names because a ScopedRegistry view adds the job label
+# to the family's labelnames, and one family cannot carry both shapes)
+MEMBER_RMS = "igg_member_rms"
+MEMBER_NONFINITE = "igg_member_nonfinite_cells"
+MEMBER_TRIPS = "igg_member_guard_trips_total"
+JOB_MEMBER_RMS = "igg_job_member_rms"
+JOB_MEMBER_NONFINITE = "igg_job_member_nonfinite_cells"
+JOB_MEMBER_TRIPS = "igg_job_member_guard_trips_total"
 
 
 def runner_cache_misses() -> float:
@@ -349,6 +359,35 @@ def observe_job_slice(scope, *, step, slice_s: float, wait_s: float,
         scope.counter(JOB_AUDIT_FINDINGS,
                       "Static-analysis findings attributed to this job's "
                       "compile-time audits.").inc(audit_findings)
+
+
+def observe_member_health(reports, scope=None) -> None:
+    """Per-member ensemble health as labeled series: stacked-layout RMS
+    and non-finite cell counts per (member, field) gauge, and a
+    per-member guard-trip counter. ``reports`` are the chunk's per-member
+    `HealthReport`s (`runtime.health.ensemble_reports_from_stats`);
+    ``scope`` routes into a job's `ScopedRegistry` view (the scheduler
+    mirrors the last chunk's members there, so batched jobs expose
+    per-member series under their own job label)."""
+    reg = scope if scope is not None else metrics_registry()
+    scoped = scope is not None
+    rms = reg.gauge(JOB_MEMBER_RMS if scoped else MEMBER_RMS,
+                    "Stacked-layout RMS per ensemble member and field.",
+                    ("member", "field"))
+    nonf = reg.gauge(JOB_MEMBER_NONFINITE if scoped else MEMBER_NONFINITE,
+                     "Non-finite cell count per ensemble member and "
+                     "field.", ("member", "field"))
+    trips = reg.counter(JOB_MEMBER_TRIPS if scoped else MEMBER_TRIPS,
+                        "Guard trips attributed to one ensemble member.",
+                        ("member",))
+    for rep in reports:
+        m = str(rep.member)
+        for field, v in rep.rms.items():
+            rms.set(v, member=m, field=field)
+        for field, v in rep.nonfinite.items():
+            nonf.set(float(v), member=m, field=field)
+        if not rep.ok:
+            trips.inc(1, member=m)
 
 
 def observe_reducers(step, values: dict, *, ok: bool = True) -> None:

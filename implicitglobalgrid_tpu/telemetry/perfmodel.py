@@ -231,7 +231,7 @@ def _axis_npairs(gg, dim: int) -> int:
 def predict_step(model, fields, *, profile: MachineProfile | None = None,
                  comm_every: int = 1, overlap: bool = False,
                  dims=None, coalesce=None, wire_dtype=None,
-                 impl: str = "xla") -> dict:
+                 impl: str = "xla", ensemble: int | None = None) -> dict:
     """Predict one step's cost on the CURRENT grid for stacked ``fields``.
 
     ``model`` is a `STEP_WORKLOADS` key or a `StepWorkload`; ``fields``
@@ -254,6 +254,16 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
     ``impl`` selects the kernel tier's exchange rounds
     (`StepWorkload.groups_for` — the fused Pallas pass may group rounds
     differently, e.g. acoustic's one packed 4-field round).
+
+    ``ensemble=E`` prices the ENSEMBLE axis (ISSUE 12): E scenario
+    members batched through one chunk — compute and wire bytes scale by
+    E while the collective LAUNCH count (and so the latency term) stays
+    flat, which is exactly the amortization the ensemble exists for. The
+    record then carries the byte-exact E-scaled totals plus the
+    ``per_member_*`` fields (``per_member_step_s``, ``per_member_comm_s``,
+    ``per_member_exposed_comm_s``), the solo prediction (``solo_step_s``)
+    and ``ensemble_amortization`` = per-member / solo step time — the
+    knob a tuner searches over E with, like any other wire knob.
 
     Returns a record with per-step seconds and the roofline verdict::
 
@@ -286,6 +296,12 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
         model_name = str(model)
     profile = profile if profile is not None else default_machine_profile()
     k = max(1, int(comm_every))
+    E = 1
+    if ensemble is not None:
+        E = int(ensemble)
+        if E < 1:
+            raise InvalidArgumentError(
+                f"predict_step: ensemble must be >= 1; got {ensemble}.")
 
     # one wire plan per exchange ROUND the step actually performs (fields
     # in a round coalesce; separate rounds pay separate launches), merged
@@ -299,7 +315,8 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
                 f"{max(group) + 1} fields in its state order "
                 f"(exchange group {group}); got {len(fields)}.")
         sub = halo_comm_plan(*(fields[i] for i in group), dims=dims,
-                             coalesce=coalesce, wire_dtype=wire_dtype)
+                             coalesce=coalesce, wire_dtype=wire_dtype,
+                             ensemble=ensemble)
         for axis, rec in sub["axes"].items():
             dst = plan["axes"].setdefault(
                 axis, {"ppermutes": 0, "wire_bytes": 0})
@@ -314,8 +331,11 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
         local_cells *= s // int(gg.dims[d]) if d < 3 else s
 
     itemsize = _itemsize_of(f0)
-    flops = work.flops_per_cell * local_cells
-    hbm_bytes = work.hbm_passes * itemsize * local_cells
+    # compute scales with the member count; the wire plan above already
+    # carries the E x payloads (same launches — the latency term below is
+    # the one cost the ensemble does NOT multiply)
+    flops = work.flops_per_cell * local_cells * E
+    hbm_bytes = work.hbm_passes * itemsize * local_cells * E
     flops_s = flops / (profile.flops_G * 1e9)
     hbm_s = hbm_bytes / (profile.membw_GBps * 1e9)
     compute_s = max(flops_s, hbm_s)
@@ -368,10 +388,11 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
              "latency_s": "latency", "wire_s": "bandwidth"}[worst]
     detail = {"flops_s": "flops", "hbm_s": "hbm",
               "latency_s": "collective-launch", "wire_s": "wire"}[worst]
-    return {
+    rec = {
         "model": model_name,
         "profile_source": profile.source,
         "local_cells": local_cells,
+        "ensemble": E,
         "compute": {"flops": flops, "hbm_bytes": hbm_bytes,
                     "flops_s": flops_s, "hbm_s": hbm_s, "s": compute_s},
         "comm": comm,
@@ -384,6 +405,21 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
         "bound_detail": detail,
         "terms": terms,
     }
+    if E > 1:
+        # the priced amortization the ROADMAP auto-tuner searches over E
+        # with: per-member cost vs the solo prediction of the SAME config
+        # (pure host arithmetic — one recursive plan merge, no devices)
+        solo = predict_step(model, fields, profile=profile,
+                            comm_every=comm_every, overlap=overlap,
+                            dims=dims, coalesce=coalesce,
+                            wire_dtype=wire_dtype, impl=impl)
+        rec["per_member_step_s"] = step_s / E
+        rec["per_member_comm_s"] = comm_s / E
+        rec["per_member_exposed_comm_s"] = exposed / E
+        rec["solo_step_s"] = solo["step_s"]
+        rec["ensemble_amortization"] = (
+            (step_s / E) / solo["step_s"] if solo["step_s"] > 0 else 1.0)
+    return rec
 
 
 def _itemsize_of(f) -> int:

@@ -415,6 +415,11 @@ def _cli(argv=None) -> int:
                           "bench scripts' convention) instead of the "
                           "default backend — a single-device backend has "
                           "no inter-shard link, so axes come out empty")
+    cal.add_argument("--ensemble", type=int, default=None,
+                     help="calibrate the per-axis link fit in the "
+                          "E-member ensemble payload regime (payload "
+                          "sizes scale by E behind the same ppermute "
+                          "pair; recorded in the profile meta)")
     cal.add_argument("--indent", type=int, default=2)
     aud = sub.add_parser(
         "audit", help="static analysis of compiled programs: collective "
@@ -446,6 +451,12 @@ def _cli(argv=None) -> int:
                      help="audit the pre-backend StableHLO instead of "
                           "backend-optimized HLO (where wire downcasts "
                           "stay visible on CPU)")
+    aud.add_argument("--ensemble", type=int, default=None,
+                     help="audit the E-member BATCHED chunk program: the "
+                          "vmapped step must keep per-axis permute "
+                          "counts identical to solo with byte-exact "
+                          "E-scaled payloads (collective count flat in "
+                          "E; XLA tier)")
     aud.add_argument("--no-crosscheck", action="store_true",
                      help="skip the predict_step pricing cross-check")
     aud.add_argument("--json", action="store_true",
@@ -504,7 +515,7 @@ def _cli(argv=None) -> int:
                              dimy=dims[1], dimz=dims[2], periodx=1,
                              periody=1, periodz=1, quiet=True)
         try:
-            profile = calibrate_machine(args.out)
+            profile = calibrate_machine(args.out, ensemble=args.ensemble)
         finally:
             if owns_grid:
                 finalize_global_grid()
@@ -657,8 +668,14 @@ def _cli_jobs(args) -> int:
                 run.setdefault("key", ("jobs_cli", rec.get("name")))
                 spec = JobSpec(
                     name=rec.pop("name"),
+                    # a batched job is JSON-describable end-to-end: the
+                    # RunSpec's ensemble knob also drives the setup's
+                    # member stacking ("perturb" ramps the members into
+                    # parameter variants)
                     setup=builtin_setup(rec.pop("model"),
-                                        rec.pop("dtype", "float32")),
+                                        rec.pop("dtype", "float32"),
+                                        ensemble=run.get("ensemble"),
+                                        perturb=rec.pop("perturb", 0.0)),
                     nt=rec.pop("nt"),
                     grid=dict(rec.pop("grid", {}) or {}),
                     run=RunSpec(**run),
@@ -808,7 +825,8 @@ def _cli_audit(args) -> int:
                 reports.append((model, audit_model(
                     model, impl=args.impl, wire_dtype=args.wire_dtype,
                     crosscheck=not args.no_crosscheck,
-                    optimized=not args.lowered)))
+                    optimized=not args.lowered,
+                    ensemble=args.ensemble)))
         finally:
             if owns_grid:
                 finalize_global_grid()

@@ -237,6 +237,14 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
         shapes[f"{_META_PREFIX}shape__{k}"] = np.asarray(v.shape,
                                                          dtype=np.int64)
         dtypes[f"{_META_PREFIX}dtype__{k}"] = np.str_(str(v.dtype))
+        # leading REPLICATED axes (the ensemble member axis, ISSUE 12):
+        # recorded per array so restore rebuilds the true sharding — a
+        # rank heuristic cannot tell a 2-D ensemble (E, x, y) from a
+        # solo 3-D field, and mis-sharding the member axis over gx would
+        # make every wanted block key miss the saved set
+        lead = _leading_replicated_axes(v)
+        if lead:
+            shapes[f"{_META_PREFIX}lead__{k}"] = np.int64(lead)
         for s in v.addressable_shards:
             if getattr(s, "replica_id", 0) != 0:
                 continue  # replicated shards: one copy is enough
@@ -277,6 +285,42 @@ def save_checkpoint_sharded(dirpath, state: dict, *,
 
     observe_checkpoint("save_sharded", time.monotonic() - t0, path=dirpath,
                        step=step)
+
+
+def _leading_replicated_axes(v) -> int:
+    """Number of LEADING replicated (``None``-spec) axes of a sharded
+    array — the ensemble member axes of `models.common.ensemble_state`'s
+    layout (0 for every solo field). Unknown sharding kinds report 0 (the
+    historical behavior)."""
+    spec = getattr(getattr(v, "sharding", None), "spec", None)
+    if spec is None:
+        return 0
+    lead = 0
+    for entry in tuple(spec):
+        if entry is not None:
+            break
+        lead += 1
+    return lead
+
+
+def _restore_sharding(meta, name, shape):
+    """The sharding a restored array takes: the recorded leading
+    replicated axes (member axes) ahead of the mesh-axis sharding of the
+    remaining rank; without the record, the rank-based default
+    (`sharding_of`)."""
+    import jax
+
+    from ..ops.alloc import sharding_of
+    from ..parallel.topology import AXIS_NAMES, global_grid
+
+    lead = int(meta.get(f"lead__{name}", 0))
+    if not lead:
+        return sharding_of(len(shape))
+    from jax.sharding import PartitionSpec as P
+
+    gg = global_grid()
+    spec = P(*([None] * lead), *AXIS_NAMES[:len(shape) - lead])
+    return jax.sharding.NamedSharding(gg.mesh, spec)
 
 
 def _sharded_meta_and_files(dirpath):
@@ -360,8 +404,6 @@ def restore_checkpoint_sharded(dirpath, *, strict: bool = True,
     open/token/checksum pass would double the restore I/O)."""
     import jax
 
-    from ..ops.alloc import sharding_of
-
     check_initialized()
     t0 = time.monotonic()
     gg = global_grid()
@@ -382,7 +424,7 @@ def restore_checkpoint_sharded(dirpath, *, strict: bool = True,
     for name in names:
         shape = tuple(int(s) for s in meta[f"shape__{name}"])
         dtype = np.dtype(str(meta[f"dtype__{name}"]))
-        sharding = sharding_of(len(shape))
+        sharding = _restore_sharding(meta, name, shape)
         needed = sharding.addressable_devices_indices_map(shape)
         plans[name] = (shape, dtype, sharding, needed)
         wanted |= {_shard_key(name, _starts_of(idx))
@@ -532,6 +574,13 @@ def restore_checkpoint_elastic(dirpath):
             np.array_equal(nxyz_o, np.asarray(gg.nxyz)):
         return restore_checkpoint_sharded(
             dirpath, _preloaded=(meta, files, checksums, verified))
+    if any(int(meta.get(f"lead__{n}", 0)) for n in names):
+        raise IncoherentArgumentError(
+            "Elastic restore of member-stacked (ensemble) state onto a "
+            "DIFFERENT decomposition is not supported: the "
+            "redistribution reasons over the 3 spatial axes and would "
+            "remap the member axis. Restore onto the saved dims "
+            "instead.")
     for field in ("overlaps", "periods", "halowidths"):
         if not np.array_equal(np.asarray(meta[field]),
                               np.asarray(getattr(gg, field))):

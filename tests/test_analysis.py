@@ -85,6 +85,26 @@ def test_parse_coalesced_fixture():
         routes=_ROUTES, max_payload_cells=4 * 512)) == []
 
 
+def test_parse_ensemble_coalesced_fixture():
+    """E=4 member-batched two-field coalesced exchange (ISSUE 12): STILL
+    exactly one permute pair on the ring — the vmapped member axis rides
+    the payload (f32[4,2,8,8]: members x packed fields x slab), 4 x the
+    solo bytes behind the solo pair count. Host-only twin of the live
+    contract check in tests/test_ensemble.py."""
+    ir = _fixture("exchange_ensemble_coalesced.hlo.txt")
+    assert len(ir.permutes) == 2
+    for op in ir.permutes:
+        pay = ir.payload_of(op)
+        assert (pay.dtype, pay.dims) == ("f32", (4, 2, 8, 8))
+        assert pay.nbytes == 2048 and ir.wire_bytes_of(op) == 16384
+        assert attribute_axis(
+            _ROUTES, op.attrs["source_target_pairs"]) == "gx"
+    assert not ir.all_reduces and not ir.all_gathers and not ir.all_to_alls
+    # slab bound at E=4: 4 members x 2 fields x 256-cell blocks
+    assert check_contract(ir, CollectiveContract(
+        routes=_ROUTES, max_payload_cells=4 * 2 * 256)) == []
+
+
 def test_parse_guarded_chunk_fixture():
     """The guarded 2-field chunk on the 2x2x2 mesh honors the structural
     guard contract host-only: exactly one f32[4] psum, six permutes, no

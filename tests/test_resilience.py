@@ -382,6 +382,111 @@ def test_elastic_restart_requires_checkpoint_dir():
 
 
 # ---------------------------------------------------------------------------
+# Ensemble axis (ISSUE 12): per-member fault isolation
+# ---------------------------------------------------------------------------
+
+def _ensemble_setup(E):
+    from implicitglobalgrid_tpu.models import (
+        diffusion_step_local, ensemble_state, init_diffusion3d,
+    )
+
+    T, Cp, p = init_diffusion3d(dtype=np.float64)
+    state = {"T": ensemble_state(T, E, perturb=0.01),
+             "Cp": ensemble_state(Cp, E)}
+
+    def step(s):
+        return {"T": diffusion_step_local(s["T"], s["Cp"], p, "xla"),
+                "Cp": s["Cp"]}
+
+    return step, state
+
+
+@pytest.mark.faults
+@pytest.mark.ensemble
+def test_ensemble_member_fault_isolated_rollback(tmp_path):
+    """THE per-member isolation claim: NaN poked into member 2 of an E=4
+    batch (NaNPoke's index leads with the member axis) trips the guard
+    for THAT member alone, the driver pins the healthy members' committed
+    output and replays from the last-good save (``member_rollback`` then
+    ``member_splice`` events, ``member_rollbacks`` counter), and the
+    final batch — survivors AND the healed member, whose poke was a
+    one-shot fault — is bit-identical to the unfaulted ensemble
+    reference. The E x policy matrix rides the slow tier below."""
+    E = 4
+    _init()
+    step, state = _ensemble_setup(E)
+    ref, ref_reports = igg.run_resilient(
+        step, state, 12, nt_chunk=3, key="ens_resil", ensemble=E,
+        checkpoint_dir=str(tmp_path / "ck_ref"))
+    assert len(ref_reports) == 4 * E  # one report per (chunk, member)
+    assert all(r.ok for r in ref_reports)
+    assert {r.member for r in ref_reports} == set(range(E))
+
+    # same grid, same runner key: the faulted run replays warm from the
+    # same compiled chunk (state arrays are immutable — reuse them)
+    _reset_health_counters()
+    igg.start_flight_recorder(str(tmp_path / "fr.jsonl"))
+    try:
+        out, reports = igg.run_resilient(
+            step, state, 12, nt_chunk=3, key="ens_resil", ensemble=E,
+            checkpoint_dir=str(tmp_path / "ck"),
+            faults=[igg.NaNPoke(step=6, name="T", index=(2, 0, 0, 0))])
+    finally:
+        igg.stop_flight_recorder()
+
+    tripped = [r for r in reports if not r.ok]
+    assert [r.member for r in tripped] == [2]
+    assert tripped[0].reasons == ("nonfinite:T",)
+    assert tripped[0].step_begin == 6
+    c = _health_counters()
+    assert c["guard_trips"] == 1 and c["rollbacks"] == 1
+    assert c["member_rollbacks"] == 1
+    evs = igg.read_flight_events(str(tmp_path / "fr.jsonl"))
+    mr = [e for e in evs if e.get("kind") == "member_rollback"]
+    ms = [e for e in evs if e.get("kind") == "member_splice"]
+    assert len(mr) == 1 and mr[0]["members"] == [2] \
+        and sorted(mr[0]["pinned"]) == [0, 1, 3]
+    assert len(ms) == 1 and sorted(ms[0]["members"]) == [0, 1, 3]
+    # survivors (and the healed member) end bit-identical to the
+    # unfaulted ensemble run — which test_ensemble.py pins member-by-
+    # member to the solo trajectories
+    assert np.array_equal(np.asarray(out["T"]), np.asarray(ref["T"]))
+    # per-member trip attribution in the registry
+    fam = igg.metrics_registry().get("igg_member_guard_trips_total")
+    trips = {l["member"]: v for l, v in fam.samples()}
+    assert trips == {"2": 1.0}
+
+
+@pytest.mark.faults
+@pytest.mark.ensemble
+@pytest.mark.slow
+def test_ensemble_two_members_tripped_same_chunk(tmp_path):
+    """Two members poked in one boundary (1 and 5 of E=8): ONE trip event
+    names both, the pin covers the other six, and the batch still ends
+    identical to the unfaulted reference (slow: a second full E=8
+    supervised pair — the fast E=4 single-member representative above is
+    the tier-1 coverage)."""
+    E = 8
+    _init()
+    step, state = _ensemble_setup(E)
+    ref, _ = igg.run_resilient(
+        step, state, 9, nt_chunk=3, key="ens_resil8", ensemble=E,
+        checkpoint_dir=str(tmp_path / "ck_ref"))
+
+    _reset_health_counters()
+    out, reports = igg.run_resilient(
+        step, state, 9, nt_chunk=3, key="ens_resil8", ensemble=E,
+        checkpoint_dir=str(tmp_path / "ck"),
+        faults=[igg.NaNPoke(step=3, name="T", index=(1, 0, 0, 0)),
+                igg.NaNPoke(step=3, name="T", index=(5, 1, 1, 1))])
+    tripped = [r for r in reports if not r.ok]
+    assert sorted(r.member for r in tripped) == [1, 5]
+    c = _health_counters()
+    assert c["guard_trips"] == 1 and c["member_rollbacks"] == 1
+    assert np.array_equal(np.asarray(out["T"]), np.asarray(ref["T"]))
+
+
+# ---------------------------------------------------------------------------
 # Fault primitives
 # ---------------------------------------------------------------------------
 
