@@ -76,6 +76,7 @@ from .telemetry import (
     MachineProfile, StepWorkload, PerfWatch, default_machine_profile,
     load_machine_profile, save_machine_profile, predict_step,
     calibrate_machine, perfdb_add, perfdb_check,
+    TunedConfig, tune_config, save_tuned_config, load_tuned_config,
 )
 from .models.common import ensemble_partition_spec, ensemble_state
 from . import io
@@ -138,6 +139,10 @@ __all__ = [
     "default_machine_profile", "load_machine_profile",
     "save_machine_profile", "predict_step", "calibrate_machine",
     "perfdb_add", "perfdb_check",
+    # closed-loop auto-tuner (search the oracle, validate with measured
+    # runs, persist, apply per job)
+    "TunedConfig", "tune_config", "save_tuned_config",
+    "load_tuned_config",
     # io (sharded snapshot & in-situ analysis pipeline)
     "io", "SnapshotWriter", "write_snapshot", "open_snapshot",
     "list_snapshots", "Probe", "AxisSlice", "Stats",

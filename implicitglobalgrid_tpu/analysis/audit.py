@@ -135,26 +135,55 @@ def _maybe_routes():
 # ---------------------------------------------------------------------------
 # model programs
 
-def _model_program(model: str, impl: str, dtype, ensemble=None):
+def _model_program(model: str, impl: str, dtype, ensemble=None,
+                   comm_every=None):
     """(runner, example args, PHYSICAL state fields in canonical order).
     With ``ensemble=E`` the runner is the E-member batched chunk
     (`make_*_run(..., ensemble=E)`) and ``args`` are the member-stacked
-    arrays — ``fields`` stay the per-member state the contracts price."""
+    arrays — ``fields`` stay the per-member state the contracts price.
+    With a deep ``comm_every`` cadence the runner is the deep-halo
+    SUPER-STEP (`make_*_run_deep` at one super-step per call — the grid
+    must carry ``depth*k_d``-wide halos per axis); XLA tier only."""
+    from ..models.common import resolve_comm_every
     from .. import models as M
 
+    cad = resolve_comm_every(comm_every if comm_every is not None else 1)
+    if cad.deep and str(impl).startswith("pallas"):
+        raise InvalidArgumentError(
+            f"audit_model: impl={impl!r} is incompatible with "
+            f"comm_every={cad} (deep-halo stepping runs only the XLA "
+            "tier — the same rule the runners enforce).")
+    ce = str(cad)
     if model in ("diffusion3d", "diffusion2d"):
         ndim = 3 if model.endswith("3d") else 2
         init = M.init_diffusion3d if ndim == 3 else M.init_diffusion2d
-        T, Cp, p = init(dtype=dtype)
-        run = M.make_run(p, 1, ndim=ndim, impl=impl, ensemble=ensemble)
+        if cad.deep:
+            T, Cp, p = M.init_diffusion3d(dtype=dtype, comm_every=ce) \
+                if ndim == 3 else M.init_diffusion2d(dtype=dtype)
+            if ndim == 2:
+                import dataclasses
+
+                p = dataclasses.replace(p, comm_every=ce)
+            run = M.make_run_deep(p, 1, ndim=ndim, ensemble=ensemble)
+        else:
+            T, Cp, p = init(dtype=dtype)
+            run = M.make_run(p, 1, ndim=ndim, impl=impl, ensemble=ensemble)
         args = (T, Cp)
     elif model == "acoustic3d":
-        state, p = M.init_acoustic3d(dtype=dtype)
-        run = M.make_acoustic_run(p, 1, impl=impl, ensemble=ensemble)
+        if cad.deep:
+            state, p = M.init_acoustic3d(dtype=dtype, comm_every=ce)
+            run = M.make_acoustic_run_deep(p, 1, ensemble=ensemble)
+        else:
+            state, p = M.init_acoustic3d(dtype=dtype)
+            run = M.make_acoustic_run(p, 1, impl=impl, ensemble=ensemble)
         args = tuple(state)
     elif model == "stokes3d":
-        state, p = M.init_stokes3d(dtype=dtype)
-        run = M.make_stokes_run(p, 1, impl=impl, ensemble=ensemble)
+        if cad.deep:
+            state, p = M.init_stokes3d(dtype=dtype, comm_every=ce)
+            run = M.make_stokes_run_deep(p, 1, ensemble=ensemble)
+        else:
+            state, p = M.init_stokes3d(dtype=dtype)
+            run = M.make_stokes_run(p, 1, impl=impl, ensemble=ensemble)
         args = tuple(state)
     else:
         raise InvalidArgumentError(
@@ -203,7 +232,8 @@ def _rounds_impl(model: str, impl: str, fields) -> str:
 def audit_model(model: str, *, impl: str = "xla", dtype=None,
                 wire_dtype=None, lints=None, crosscheck: bool = True,
                 optimized: bool = True,
-                ensemble: int | None = None) -> AuditReport:
+                ensemble: int | None = None,
+                comm_every=None) -> AuditReport:
     """Compile one model family's step program on the CURRENT grid and
     audit it against its plan-derived contract.
 
@@ -228,16 +258,31 @@ def audit_model(model: str, *, impl: str = "xla", dtype=None,
     normalizes narrow payloads back to full precision (XLA:CPU does for
     bf16) the LOWERED module is audited instead of the optimized one —
     ``meta["lowered_for_wire_audit"]`` records the switch — so the
-    documented CLI gate never false-fails a healthy program."""
+    documented CLI gate never false-fails a healthy program.
+
+    ``comm_every`` (a deep per-axis cadence — int / ``"z:2,x:1"`` /
+    dict) audits the DEEP-HALO SUPER-STEP program instead of the plain
+    step: the compiled cycle's per-axis permute counts and k_d-wide
+    payload bytes must equal the super-cycle contract
+    (`model_contract(comm_every=)`), and the crosscheck proves
+    `predict_step`'s per-axis amortized pricing against the emitted
+    collectives. The current grid must carry the cadence's halo
+    geometry (``halowidths[d] = depth*k_d``); composes with
+    ``ensemble`` (the vmapped deep chunk) and per-axis ``wire_dtype``.
+    XLA tier only."""
     import os
 
     import numpy as np
 
+    from ..models.common import resolve_comm_every
     from ..parallel.topology import check_initialized
 
     check_initialized()
     dtype = np.float32 if dtype is None else dtype
     meta = {"model": model, "impl": impl}
+    cad = resolve_comm_every(comm_every if comm_every is not None else 1)
+    if cad.deep:
+        meta["comm_every"] = str(cad)
     if ensemble is not None:
         ensemble = int(ensemble)
         meta["ensemble"] = ensemble
@@ -265,7 +310,8 @@ def audit_model(model: str, *, impl: str = "xla", dtype=None,
                         "full precision in optimized HLO; audited the "
                         "lowered module instead")
         runner, args, fields = _model_program(model, impl, dtype,
-                                              ensemble=ensemble)
+                                              ensemble=ensemble,
+                                              comm_every=comm_every)
         ir = parse_program(runner, *args, optimized=optimized)
     finally:
         if saved_wire is None:
@@ -274,7 +320,7 @@ def audit_model(model: str, *, impl: str = "xla", dtype=None,
             os.environ["IGG_HALO_WIRE_DTYPE"] = saved_wire
     from ..telemetry.perfmodel import STEP_WORKLOADS
 
-    rounds_impl = _rounds_impl(model, impl, fields)
+    rounds_impl = impl if cad.deep else _rounds_impl(model, impl, fields)
     if rounds_impl != impl:
         meta["rounds_impl"] = (
             f"{rounds_impl} (fused kernel ineligible on this grid/state; "
@@ -283,7 +329,8 @@ def audit_model(model: str, *, impl: str = "xla", dtype=None,
     contract = None
     if model in STEP_WORKLOADS:
         contract = model_contract(model, fields, wire_dtype=wire_dtype,
-                                  impl=rounds_impl, ensemble=ensemble)
+                                  impl=rounds_impl, ensemble=ensemble,
+                                  comm_every=comm_every)
     cfg = default_lint_config(
         state_dtypes={str(np.dtype(getattr(f, "dtype", "float32")))
                       for f in fields},
@@ -294,7 +341,8 @@ def audit_model(model: str, *, impl: str = "xla", dtype=None,
     if crosscheck and model in STEP_WORKLOADS:
         cc = perfmodel_crosscheck(model, fields, ir,
                                   wire_dtype=wire_dtype, impl=rounds_impl,
-                                  ensemble=ensemble)
+                                  ensemble=ensemble,
+                                  comm_every=comm_every)
     if cc is None:
         return rep
     return AuditReport(

@@ -235,7 +235,7 @@ def hlo_dtype(name) -> str:
 
 
 def _merged_plan(fields, rounds, *, dims=None, coalesce=None,
-                 wire_dtype=None, ensemble=None) -> dict:
+                 wire_dtype=None, ensemble=None, comm_every=None) -> dict:
     """Per-axis {ppermutes, wire_bytes, dtypes} merged over the exchange
     rounds exactly as `telemetry.predict_step` merges them: fields in one
     round coalesce, separate rounds pay separate permutes.
@@ -247,8 +247,17 @@ def _merged_plan(fields, rounds, *, dims=None, coalesce=None,
     while the compiled permute's pair list enumerates every parallel
     line of the mesh, so each axis scales by the perpendicular line
     count (total shards / that axis's extent). Dtypes are converted to
-    HLO spelling to match the parsed payloads."""
+    HLO spelling to match the parsed payloads.
+
+    ``comm_every`` (a deep per-axis cadence — `ops.wire.CommCadence` /
+    its spellings) switches the merge to the deep-halo SUPER-CYCLE: the
+    compiled super-step advances ``lcm(k_d)`` physical steps, issuing
+    each round only along the axes due at each sub-step
+    (`CommCadence.due_dims` — the `models.*.deep_step` schedule), so the
+    merged totals are per SUPER-STEP program: axis ``d`` carries
+    ``cycle / k_d`` exchanges of its ``depth*k_d``-wide slabs."""
     from ..ops.halo import halo_comm_plan
+    from ..ops.wire import resolve_comm_every
     from ..parallel.topology import AXIS_NAMES, global_grid
 
     gg = global_grid()
@@ -258,22 +267,31 @@ def _merged_plan(fields, rounds, *, dims=None, coalesce=None,
         total *= d
     axis_dim = {a: i for i, a in enumerate(AXIS_NAMES)}
     fields = tuple(fields)
+    cad = resolve_comm_every(comm_every if comm_every is not None else 1)
+    if cad.deep:
+        # one (sub-step, due-axes) exchange event per cycle entry; the
+        # caller's dims order is the within-event processing order
+        events = [cad.due_dims(j) for j in range(cad.cycle)]
+        events = [e for e in events if e]
+    else:
+        events = [dims]
     merged: dict = {}
-    for group in rounds:
-        if any(i >= len(fields) for i in group):
-            raise InvalidArgumentError(
-                f"exchange round {tuple(group)} indexes past the "
-                f"{len(fields)} given fields.")
-        sub = halo_comm_plan(*(fields[i] for i in group), dims=dims,
-                             coalesce=coalesce, wire_dtype=wire_dtype,
-                             ensemble=ensemble)
-        for axis, rec in sub["axes"].items():
-            n_lines = total // gdims[axis_dim[axis]]
-            dst = merged.setdefault(
-                axis, {"permutes": 0, "wire_bytes": 0, "dtypes": set()})
-            dst["permutes"] += int(rec["ppermutes"])
-            dst["wire_bytes"] += int(rec["wire_bytes"]) * n_lines
-            dst["dtypes"].update(hlo_dtype(d) for d in rec["by_dtype"])
+    for ev_dims in events:
+        for group in rounds:
+            if any(i >= len(fields) for i in group):
+                raise InvalidArgumentError(
+                    f"exchange round {tuple(group)} indexes past the "
+                    f"{len(fields)} given fields.")
+            sub = halo_comm_plan(*(fields[i] for i in group), dims=ev_dims,
+                                 coalesce=coalesce, wire_dtype=wire_dtype,
+                                 ensemble=ensemble)
+            for axis, rec in sub["axes"].items():
+                n_lines = total // gdims[axis_dim[axis]]
+                dst = merged.setdefault(
+                    axis, {"permutes": 0, "wire_bytes": 0, "dtypes": set()})
+                dst["permutes"] += int(rec["ppermutes"])
+                dst["wire_bytes"] += int(rec["wire_bytes"]) * n_lines
+                dst["dtypes"].update(hlo_dtype(d) for d in rec["by_dtype"])
     return merged
 
 
@@ -294,7 +312,7 @@ def _local_block_cells(fields) -> int:
 
 def exchange_contract(*fields, rounds=None, dims=None, coalesce=None,
                       wire_dtype=None, guard_floats: int | None = None,
-                      ensemble: int | None = None,
+                      ensemble: int | None = None, comm_every=None,
                       meta=None) -> CollectiveContract:
     """Derive the contract for an exchange (or a step program) over the
     CURRENT grid from the static wire plan alone.
@@ -303,7 +321,12 @@ def exchange_contract(*fields, rounds=None, dims=None, coalesce=None,
     ``(A, hw)`` tuples, ``jax.ShapeDtypeStruct``). ``rounds`` lists the
     exchange rounds as tuples of field indices (default: one coalesced
     round of every field — `STEP_WORKLOADS[...].exchange_groups` for a
-    model step). ``guard_floats`` adds the resilient runtime's psum
+    model step). ``comm_every`` (a deep per-axis cadence) derives the
+    DEEP-HALO SUPER-STEP program's contract: per-axis permute counts and
+    byte-exact k_d-wide payloads merged over the cadence cycle's due
+    schedule (`_merged_plan` — axis ``d`` carries ``lcm(k)/k_d``
+    exchanges per compiled super-step). ``guard_floats`` adds the
+    resilient runtime's psum
     expectation: exactly one f32 all-reduce of that many floats.
     ``ensemble=E`` is the E-member batched program's contract (fields
     stay the PHYSICAL per-member shapes): identical per-axis permute
@@ -326,10 +349,14 @@ def exchange_contract(*fields, rounds=None, dims=None, coalesce=None,
                 f"{ensemble}.")
     rounds = rounds if rounds is not None else (tuple(range(len(fields))),)
     merged = _merged_plan(fields, rounds, dims=dims, coalesce=coalesce,
-                          wire_dtype=wire_dtype, ensemble=ensemble)
+                          wire_dtype=wire_dtype, ensemble=ensemble,
+                          comm_every=comm_every)
     axes = {a: {"permutes": r["permutes"], "wire_bytes": r["wire_bytes"],
                 "dtypes": tuple(sorted(r["dtypes"]))}
             for a, r in merged.items() if r["permutes"]}
+    from ..ops.wire import resolve_comm_every
+
+    cad = resolve_comm_every(comm_every if comm_every is not None else 1)
     return CollectiveContract(
         axes=axes,
         routes=axis_routes(gg),
@@ -339,20 +366,28 @@ def exchange_contract(*fields, rounds=None, dims=None, coalesce=None,
         max_payload_cells=_local_block_cells(fields) * E,
         meta=dict(meta or {}, dims=[int(d) for d in gg.dims],
                   periods=[int(p) for p in gg.periods],
-                  **({"ensemble": E} if E > 1 else {})))
+                  **({"ensemble": E} if E > 1 else {}),
+                  **({"comm_every": str(cad)} if cad.deep else {})))
 
 
 def model_contract(model, fields, *, dims=None, coalesce=None,
                    wire_dtype=None, impl: str = "xla",
                    guard_floats: int | None = None,
-                   ensemble: int | None = None) -> CollectiveContract:
+                   ensemble: int | None = None,
+                   comm_every=None) -> CollectiveContract:
     """The step contract of a model family: exchange rounds from
     `telemetry.STEP_WORKLOADS[model]`, priced over the model's state
     ``fields`` (canonical state order — PHYSICAL per-member shapes when
     ``ensemble`` is set). ``impl`` picks the kernel tier's rounds
     (`StepWorkload.groups_for`): both tiers ride the canonical wire
     schema, so a fused Pallas program gets the same byte-exact contract
-    as the XLA path — only the round grouping may differ."""
+    as the XLA path — only the round grouping may differ. A deep
+    ``comm_every`` cadence selects the deep runner's rounds
+    (``deep_exchange_groups`` — XLA tier) and the super-cycle merge of
+    `exchange_contract`: the contract then describes ONE compiled
+    super-step, with each axis's permute count amortized by its own
+    cadence."""
+    from ..ops.wire import resolve_comm_every
     from ..telemetry.perfmodel import STEP_WORKLOADS
 
     work = STEP_WORKLOADS.get(str(model))
@@ -360,10 +395,11 @@ def model_contract(model, fields, *, dims=None, coalesce=None,
         raise InvalidArgumentError(
             f"model_contract: unknown model {model!r} "
             f"(have {sorted(STEP_WORKLOADS)}).")
+    cad = resolve_comm_every(comm_every if comm_every is not None else 1)
     return exchange_contract(
-        *fields, rounds=work.groups_for(impl), dims=dims,
+        *fields, rounds=work.groups_for(impl, deep=cad.deep), dims=dims,
         coalesce=coalesce, wire_dtype=wire_dtype, guard_floats=guard_floats,
-        ensemble=ensemble,
+        ensemble=ensemble, comm_every=comm_every,
         meta={"model": str(model), "impl": str(impl)})
 
 
@@ -525,7 +561,8 @@ def check_contract(ir: ProgramIR, contract: CollectiveContract) -> list:
 def perfmodel_crosscheck(model, fields, ir: ProgramIR, *, profile=None,
                          dims=None, coalesce=None, wire_dtype=None,
                          impl: str = "xla",
-                         ensemble: int | None = None) -> dict:
+                         ensemble: int | None = None,
+                         comm_every=None) -> dict:
     """Prove `telemetry.predict_step`'s collective pricing against the
     compiled program: per mesh axis, the oracle's priced ppermute PAIRS
     and all-links wire bytes must equal what the parser measured in the
@@ -534,24 +571,39 @@ def perfmodel_crosscheck(model, fields, ir: ProgramIR, *, profile=None,
     a caught ``perfmodel-drift`` finding instead of silent mispricing.
     With ``ensemble=E`` the oracle prices the E-member batched program
     (same pairs, E x bytes) against the vmapped compile — proving the
-    amortization claim byte-exactly."""
+    amortization claim byte-exactly. With a deep ``comm_every`` cadence
+    the parsed program is the compiled SUPER-STEP (one cadence cycle):
+    the oracle's per-exchange pairs scale by each axis's
+    ``cycle / k_d`` events per cycle — proving the per-axis amortization
+    (latency term ÷ k_axis) against exactly what the compiler emitted."""
+    from ..ops.wire import resolve_comm_every
     from ..parallel.topology import check_initialized, global_grid
     from ..telemetry.perfmodel import predict_step
 
     check_initialized()
     gg = global_grid()
+    cad = resolve_comm_every(comm_every if comm_every is not None else 1)
     pred = predict_step(model, fields, profile=profile, dims=dims,
                         coalesce=coalesce, wire_dtype=wire_dtype, impl=impl,
-                        ensemble=ensemble)
+                        ensemble=ensemble, comm_every=cad)
     plan = _merged_plan(fields,
-                        _exchange_rounds(model, len(fields), impl),
+                        _exchange_rounds(model, len(fields), impl,
+                                         deep=cad.deep),
                         dims=dims, coalesce=coalesce, wire_dtype=wire_dtype,
-                        ensemble=ensemble)
+                        ensemble=ensemble, comm_every=cad)
     parsed = measure_axes(ir, axis_routes(gg))
+    from ..parallel.topology import AXIS_NAMES
+
+    axis_dim = {a: i for i, a in enumerate(AXIS_NAMES)}
     findings: list = []
     axes: dict = {}
     for axis in sorted(set(plan) | set(k for k in parsed if k is not None)):
-        modeled_pairs = pred["comm"].get(axis, {}).get("ppermute_pairs", 0.0)
+        # events per compiled program: 1 per step normally; under a deep
+        # cadence the super-step fires this axis cycle/k_d times
+        events = (cad.cycle // cad.for_dim(axis_dim[axis])
+                  if cad.deep else 1)
+        modeled_pairs = events * pred["comm"].get(axis, {}).get(
+            "ppermute_pairs", 0.0)
         modeled_bytes = plan.get(axis, {}).get("wire_bytes", 0)
         # the pairs come from predict_step (the oracle under test), the
         # all-links bytes from this module's round merge — the two price
@@ -594,16 +646,18 @@ def perfmodel_crosscheck(model, fields, ir: ProgramIR, *, profile=None,
     return {"ok": not findings, "findings": findings, "axes": axes,
             "model": str(model), "impl": str(impl),
             "ensemble": int(pred.get("ensemble", 1)),
+            "comm_every": str(cad),
             "profile_source": pred["profile_source"]}
 
 
-def _exchange_rounds(model, n_fields: int, impl: str = "xla"):
+def _exchange_rounds(model, n_fields: int, impl: str = "xla",
+                     deep: bool = False):
     from ..telemetry.perfmodel import STEP_WORKLOADS, StepWorkload
 
     if isinstance(model, StepWorkload):
-        return model.groups_for(impl)
+        return model.groups_for(impl, deep=deep)
     work = STEP_WORKLOADS.get(str(model))
     if work is None:
         raise InvalidArgumentError(
             f"unknown model {model!r} (have {sorted(STEP_WORKLOADS)}).")
-    return work.groups_for(impl)
+    return work.groups_for(impl, deep=deep)

@@ -29,7 +29,8 @@ from ..tools import coords_g, nx_g, ny_g, nz_g
 from .common import make_state_runner, run_chunked
 
 __all__ = ["AcousticParams", "init_acoustic3d", "acoustic_step_local",
-           "make_acoustic_run", "make_acoustic_run_deep", "run_acoustic"]
+           "make_acoustic_run", "make_acoustic_run_deep", "deep_step",
+           "run_acoustic"]
 
 
 @dataclass(frozen=True)
@@ -40,8 +41,12 @@ class AcousticParams:
     P-round — one collective round where the base scheme does 2k. Between exchanges the V
     updates retreat ``j`` cells per neighbor side at sub-step j (their P
     dependencies are j sub-steps stale) and the P update retreats
-    ``j+1`` (it needs the CURRENT sub-step's V). XLA tier; ignores
-    ``overlap``; needs ``overlaps >= 2k, halowidths = k`` grids.
+    ``j+1`` (it needs the CURRENT sub-step's V). The cadence is PER MESH
+    AXIS (``"z:4,x:1"`` / ``IGG_COMM_EVERY`` — see
+    `DiffusionParams.comm_every`): along each axis the retreats advance
+    at that axis's own staleness and the 4-field exchange fires only on
+    the axes due that sub-step. XLA tier; ignores ``overlap``; needs
+    ``overlaps[d] >= 2*k_d, halowidths[d] = k_d`` grids.
     Trajectory is bit-identical (tests/test_comm_avoid.py)."""
     rho: float      # density
     K: float        # bulk modulus
@@ -50,11 +55,11 @@ class AcousticParams:
     dy: float
     dz: float
     overlap: bool = False   # hide_communication for the P update
-    comm_every: int = 1
+    comm_every: int | str = 1
 
 
 def init_acoustic3d(*, rho=1.0, K=1.0, lx=10.0, ly=10.0, lz=10.0,
-                    dtype=None, overlap=False, comm_every=1):
+                    dtype=None, overlap=False, comm_every=None):
     """State (P, Vx, Vy, Vz) with a Gaussian pressure pulse in the center.
     Velocities live on faces: Vx is local ``(nx+1, ny, nz)`` (staggered —
     exercised exactly like the reference's `Vx = zeros(nx+1, ...)` pattern,
@@ -78,9 +83,11 @@ def init_acoustic3d(*, rho=1.0, K=1.0, lx=10.0, ly=10.0, lz=10.0,
     Vx = zeros_g((nx + 1, ny, nz), dtype=dtype)
     Vy = zeros_g((nx, ny + 1, nz), dtype=dtype)
     Vz = zeros_g((nx, ny, nz + 1), dtype=dtype)
+    from .common import resolve_comm_every
+
     return (P, Vx, Vy, Vz), AcousticParams(
         rho=rho, K=K, dt=dt, dx=dx, dy=dy, dz=dz, overlap=overlap,
-        comm_every=comm_every)
+        comm_every=str(resolve_comm_every(comm_every)))
 
 
 def acoustic_step_local(state, p: AcousticParams, impl: str = "xla"):
@@ -140,29 +147,34 @@ def acoustic_step_local(state, p: AcousticParams, impl: str = "xla"):
     return (P, Vx, Vy, Vz)
 
 
-def make_acoustic_run_deep(p: AcousticParams, nt_chunk_super: int):
-    """Deep-halo leapfrog runner: ONE super-step = ``p.comm_every``
-    masked sub-steps + ONE 4-field k-wide exchange.
+def deep_step(p: AcousticParams):
+    """The deep-halo leapfrog SUPER-STEP as a local step function:
+    ``lcm(k_d)`` masked sub-steps with the 4-field k-wide exchange fired
+    per axis at its own cadence. Returns ``(step, cycle)``.
 
-    Sub-step ``j`` masks (neighbor sides; `common.fresh_mask`):
-    - each V field: retreat ``j`` with base offset 1 in its staggered
+    Sub-step masks, per dim ``d`` with staleness ``r_d = j mod k_d``
+    (neighbor sides; `common.fresh_mask`):
+    - each V field: retreat ``r_d`` with base offset 1 in its staggered
       dim (of its n+1 faces the base update touches ``[1, n)`` —
       ``at[1:-1]``, so base_hi=1 off the n+1 length) and 0 elsewhere —
-      its P dependencies are ``j`` sub-steps stale;
-    - P: retreat ``j+1`` with base 0 (the base update touches every
+      its P dependencies are ``r_d`` sub-steps stale along ``d``;
+    - P: retreat ``r_d + 1`` with base 0 (the base update touches every
       cell) — it consumes THIS sub-step's V, whose faces have retreated
-      ``j+1`` in the staggered dim.
-    The skipped bands (<= k wide after k sub-steps) are exactly what the
-    k-wide exchange overwrites."""
+      ``r_d + 1`` in the staggered dim.
+    The skipped bands (<= k_d wide between that axis's exchanges) are
+    exactly what the k_d-wide exchange overwrites."""
     import jax.numpy as jnp
     from jax import lax
 
-    from .common import fresh_mask, make_state_runner, validate_deep_halo
+    from .common import (
+        fresh_mask, resolve_comm_every, validate_deep_halo,
+    )
 
     check_initialized()
     gg = global_grid()
-    k = int(p.comm_every)
-    validate_deep_halo(gg, 3, k)
+    cad = resolve_comm_every(p.comm_every)
+    validate_deep_halo(gg, 3, cad)
+    K = cad.cycle
 
     def dP(A, d):
         n = A.shape[d]
@@ -171,25 +183,44 @@ def make_acoustic_run_deep(p: AcousticParams, nt_chunk_super: int):
 
     def step(state):
         P, Vx, Vy, Vz = state
-        for j in range(k):
+        for j in range(K):
+            r = cad.retreats(j)
             Vn = [Vx.at[1:-1, :, :].add(-p.dt / p.rho * dP(P, 0) / p.dx),
                   Vy.at[:, 1:-1, :].add(-p.dt / p.rho * dP(P, 1) / p.dy),
                   Vz.at[:, :, 1:-1].add(-p.dt / p.rho * dP(P, 2) / p.dz)]
-            if j:
+            if any(r):
                 Vn = [jnp.where(fresh_mask(
-                          Vn[s].shape, j,
+                          Vn[s].shape, r,
                           tuple(1 if d == s else 0 for d in range(3)),
                           tuple(1 if d == s else 0 for d in range(3))),
                           Vn[s], (Vx, Vy, Vz)[s]) for s in range(3)]
             Vx, Vy, Vz = Vn
             Pn = P - p.dt * p.K * (dP(Vx, 0) / p.dx + dP(Vy, 1) / p.dy
                                    + dP(Vz, 2) / p.dz)
-            P = jnp.where(fresh_mask(P.shape, j + 1, (0, 0, 0), (0, 0, 0)),
+            P = jnp.where(fresh_mask(P.shape, tuple(x + 1 for x in r),
+                                     (0, 0, 0), (0, 0, 0)),
                           Pn, P)
-        return local_update_halo(P, Vx, Vy, Vz)
+            due = cad.due_dims(j)
+            if due:
+                P, Vx, Vy, Vz = local_update_halo(P, Vx, Vy, Vz, dims=due)
+        return (P, Vx, Vy, Vz)
 
+    return step, K
+
+
+def make_acoustic_run_deep(p: AcousticParams, nt_chunk_super: int,
+                           ensemble: int | None = None):
+    """Deep-halo leapfrog runner: ONE super-step = the cadence cycle of
+    masked sub-steps (`deep_step`) with per-axis 4-field k-wide
+    exchanges. ``ensemble=E`` batches E members through the same deep
+    collectives (XLA tier)."""
+    from .common import make_state_runner, resolve_comm_every
+
+    step, _ = deep_step(p)
+    cad = resolve_comm_every(p.comm_every)
     return make_state_runner(step, (3, 3, 3, 3), nt_chunk=nt_chunk_super,
-                             key=("acoustic3d_deep", p))
+                             key=("acoustic3d_deep", p, str(cad), ensemble),
+                             ensemble=ensemble)
 
 
 def _resolve_impl(impl):
@@ -201,11 +232,13 @@ def _resolve_impl(impl):
 def make_acoustic_run(p: AcousticParams, nt_chunk: int,
                       impl: str | None = None,
                       ensemble: int | None = None):
-    if p.comm_every > 1:
+    from .common import resolve_comm_every
+
+    if resolve_comm_every(p.comm_every).deep:
         from ..utils.exceptions import InvalidArgumentError
 
         raise InvalidArgumentError(
-            f"AcousticParams(comm_every={p.comm_every}) needs the "
+            f"AcousticParams(comm_every={p.comm_every!r}) needs the "
             "deep-halo runner: use run_acoustic or make_acoustic_run_deep "
             "(make_acoustic_run exchanges every step).")
     if ensemble is not None:
@@ -224,30 +257,28 @@ def make_acoustic_run(p: AcousticParams, nt_chunk: int,
 
 def run_acoustic(state, p: AcousticParams, nt: int, *, nt_chunk: int = 100,
                  impl: str | None = None, ensemble: int | None = None):
-    if ensemble is not None:
-        if p.comm_every > 1:
-            from ..utils.exceptions import InvalidArgumentError
+    from ..utils.exceptions import InvalidArgumentError
+    from .common import resolve_comm_every
 
+    cad = resolve_comm_every(p.comm_every)
+    if cad.deep:
+        if impl is not None and not impl.startswith("xla"):
             raise InvalidArgumentError(
-                "ensemble batching supports the plain XLA leapfrog only "
-                "(comm_every > 1 is a solo-run feature).")
+                f"impl={impl!r} is incompatible with comm_every={cad}: "
+                "deep-halo stepping currently runs only the XLA tier.")
+        K = cad.cycle
+        if nt % K:
+            raise InvalidArgumentError(
+                f"nt={nt} must be a multiple of the cadence cycle {K} "
+                f"(comm_every={cad} defines the trajectory).")
+        E = None if ensemble is None else int(ensemble)
+        return run_chunked(
+            lambda c: make_acoustic_run_deep(p, c, ensemble=E), state,
+            nt // K, max(1, nt_chunk // K))
+    if ensemble is not None:
         return run_chunked(
             lambda c: make_acoustic_run(p, c, impl, ensemble=int(ensemble)),
             state, nt, nt_chunk)
-    if p.comm_every > 1:
-        from ..utils.exceptions import InvalidArgumentError
-
-        k = int(p.comm_every)
-        if impl is not None and not impl.startswith("xla"):
-            raise InvalidArgumentError(
-                f"impl={impl!r} is incompatible with comm_every={k}: "
-                "deep-halo stepping currently runs only the XLA tier.")
-        if nt % k:
-            raise InvalidArgumentError(
-                f"nt={nt} must be a multiple of comm_every={k} (the "
-                "exchange cadence defines the trajectory).")
-        return run_chunked(lambda c: make_acoustic_run_deep(p, c), state,
-                           nt // k, max(1, nt_chunk // k))
     impl = _resolve_impl(impl)
     return run_chunked(lambda c: make_acoustic_run(p, c, impl), state, nt,
                        nt_chunk)

@@ -14,8 +14,9 @@ from ..parallel.topology import check_initialized, global_grid
 
 __all__ = ["make_state_runner", "run_chunked", "default_check_vma",
            "resolve_pallas_impl", "fresh_mask", "validate_deep_halo",
-           "interior_first_step", "ensemble_partition_spec",
-           "ensemble_state", "resolve_ensemble_impl"]
+           "resolve_comm_every", "interior_first_step",
+           "ensemble_partition_spec", "ensemble_state",
+           "resolve_ensemble_impl"]
 
 _runner_cache: dict = {}
 
@@ -123,23 +124,29 @@ def fresh_mask(shape, retreat, base_lo, base_hi):
     """Update-region mask for communication-avoiding deep-halo sub-steps
     (True = this cell's stencil dependencies are fresh).
 
-    Per dim ``d``: ``[base_lo[d] + retreat·L, n_d - base_hi[d] -
-    retreat·R)`` where L/R flag a neighbor on that side of THIS shard
+    Per dim ``d``: ``[base_lo[d] + retreat_d·L, n_d - base_hi[d] -
+    retreat_d·R)`` where L/R flag a neighbor on that side of THIS shard
     (`lax.axis_index` per mesh axis — one SPMD program serves edge and
     interior shards; periodic sides always have a neighbor, incl. self).
     ``base_lo/hi`` encode the scheme's exchange-fresh update region
     (diffusion interior: 1/1; a face-staggered dim: 1/1; a full-array
     update: 0/0); ``retreat`` is how many sub-steps of staleness the
-    field's dependencies have accumulated. The skipped cells keep stale
-    values and are overwritten by the next k-wide exchange — which is why
-    deep-halo trajectories stay bit-identical (tests/test_comm_avoid.py).
+    field's dependencies have accumulated — a scalar, or a PER-DIM
+    sequence under a per-axis cadence (`CommCadence`: each axis's
+    staleness advances at its own rate between its own exchanges). The
+    skipped cells keep stale values and are overwritten by that axis's
+    next k-wide exchange — which is why deep-halo trajectories stay
+    bit-identical (tests/test_comm_avoid.py).
     """
+    import numpy as np
+
     import jax.numpy as jnp
     from jax import lax
 
     from ..parallel.topology import AXIS_NAMES, global_grid
 
     gg = global_grid()
+    per_dim = np.iterable(retreat)
     m = None
     for d in range(len(shape)):
         idx = lax.axis_index(AXIS_NAMES[d])
@@ -147,8 +154,9 @@ def fresh_mask(shape, retreat, base_lo, base_hi):
         has_l = jnp.logical_or(idx > 0, per)
         has_r = jnp.logical_or(idx < int(gg.dims[d]) - 1, per)
         i = jnp.arange(shape[d])
-        lo = base_lo[d] + jnp.where(has_l, retreat, 0)
-        hi = shape[d] - base_hi[d] - jnp.where(has_r, retreat, 0)
+        r_d = retreat[d] if per_dim else retreat
+        lo = base_lo[d] + jnp.where(has_l, r_d, 0)
+        hi = shape[d] - base_hi[d] - jnp.where(has_r, r_d, 0)
         md = (i >= lo) & (i < hi)
         md = md.reshape([-1 if dd == d else 1
                          for dd in range(len(shape))])
@@ -156,35 +164,48 @@ def fresh_mask(shape, retreat, base_lo, base_hi):
     return m
 
 
-def validate_deep_halo(gg, ndim: int, k: int, depth_per_step: int = 1
-                       ) -> None:
-    """Shared `comm_every` coherence checks. ``depth_per_step`` is the
+def resolve_comm_every(comm_every=None):
+    """The models' entry to the per-axis cadence resolver
+    (`ops.wire.resolve_comm_every`): int / ``"z:4,x:1"`` / dict /
+    `CommCadence` / ``None`` (= consult ``IGG_COMM_EVERY``, default 1)
+    -> `CommCadence`."""
+    from ..ops.wire import resolve_comm_every as _resolve
+
+    return _resolve(comm_every)
+
+
+def validate_deep_halo(gg, ndim: int, k, depth_per_step: int = 1) -> None:
+    """Shared `comm_every` coherence checks. ``k`` is the cadence — an
+    int or a resolved `CommCadence` (per-axis). ``depth_per_step`` is the
     scheme's per-sub-step dependency radius — 1 for radius-1 stencils
     (diffusion, the acoustic leapfrog), 2 for the Stokes PT iteration
     (V needs stresses which need V: the band retreats 2 cells per
-    iteration). Every exchanging dim needs halo depth >= depth_per_step·k
-    AND local size >= overlap + depth_per_step·k (the send slabs must
-    stay inside the LAST sub-step's freshly-updated region, or an
-    interior shard silently ships one-sub-step-stale values)."""
+    iteration). Every exchanging dim ``d`` needs halo depth >=
+    depth_per_step·k_d AND local size >= overlap + depth_per_step·k_d
+    (the send slabs must stay inside the LAST sub-step's freshly-updated
+    region, or an interior shard silently ships one-sub-step-stale
+    values)."""
     from ..utils.exceptions import IncoherentArgumentError
 
-    need = depth_per_step * k
+    cad = resolve_comm_every(k)
     for d in range(ndim):
+        k_d = cad.for_dim(d)
+        need = depth_per_step * k_d
         exchanging = int(gg.dims[d]) > 1 or int(gg.periods[d])
         if not exchanging:
             continue
         if int(gg.halowidths[d]) < need:
             raise IncoherentArgumentError(
-                f"comm_every={k} needs halowidths[{d}] >= {need} on every "
-                f"exchanging dim (got {int(gg.halowidths[d])}): init the "
-                f"grid with overlaps >= {2 * need} and "
-                f"halowidths=({need},...).")
+                f"comm_every={cad} needs halowidths[{d}] >= {need} on "
+                f"every exchanging dim (got {int(gg.halowidths[d])}): "
+                f"init the grid with overlaps[{d}] >= {2 * need} and "
+                f"halowidths[{d}] = {need}.")
         n_d, ol_d = int(gg.nxyz[d]), int(gg.overlaps[d])
         if n_d < ol_d + need:
             raise IncoherentArgumentError(
-                f"comm_every={k} needs local size >= overlap + {need} on "
-                f"dim {d} (got n={n_d}, overlap={ol_d}): the send slabs "
-                "would leave the freshly-updated region.")
+                f"comm_every={cad} needs local size >= overlap + {need} "
+                f"on dim {d} (got n={n_d}, overlap={ol_d}): the send "
+                "slabs would leave the freshly-updated region.")
 
 
 def interior_first_step(update_fn, outs, aux=(), *, radius: int = 1,

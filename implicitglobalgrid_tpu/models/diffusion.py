@@ -29,7 +29,7 @@ from ..tools import coords_g, nx_g, ny_g, nz_g
 
 __all__ = ["DiffusionParams", "init_diffusion3d", "init_diffusion2d",
            "diffusion_step_local", "make_step", "make_run", "make_run_sr",
-           "make_run_deep", "run_diffusion"]
+           "make_run_deep", "deep_step", "run_diffusion"]
 
 
 @dataclass(frozen=True)
@@ -64,9 +64,19 @@ class DiffusionParams:
     tests/test_comm_avoid.py). Same wire bytes per step; 1/k the
     collective count and latency — the lever for latency-bound regimes
     (small blocks in strong scaling, DCN-crossing axes; see
-    `exposed_comm_ms_per_step` in WEAK_SCALING.json). XLA tier; ignores
-    ``overlap``; init the grid with overlaps >= 2k (e.g.
-    ``init_global_grid(..., overlaps=(2*k,)*3, halowidths=(k,)*3)``)."""
+    `exposed_comm_ms_per_step` in WEAK_SCALING.json).
+
+    The cadence is PER MESH AXIS (`ops.wire.resolve_comm_every` — the
+    `wire_dtype` spelling family): an int ``k``, a spec like ``"z:4"`` /
+    ``"z:4,x:1"`` (axes x/y/z or gx/gy/gz; unnamed axes exchange every
+    step), a ``{axis: k}`` dict, or ``None`` to consult
+    ``IGG_COMM_EVERY``. A slow DCN-mapped axis can then amortize its
+    collective latency over ``k`` steps while ICI axes keep per-step
+    exchanges and 1-wide halos — the configuration where a UNIFORM
+    cadence loses on slab-width compute (the Stokes COMM_AVOID.json row)
+    turns into a win. Each axis ``d`` needs ``halowidths[d] = k_d`` /
+    ``overlaps[d] >= 2*k_d``; the compiled super-step advances
+    ``lcm(k_d)`` physical steps. XLA tier; ignores ``overlap``."""
     lam: float      # thermal conductivity
     dt: float
     dx: float
@@ -75,7 +85,7 @@ class DiffusionParams:
     overlap: bool = False
     sr: bool = False
     sr_seed: int = 0
-    comm_every: int = 1
+    comm_every: int | str = 1
 
 
 def _gaussian(x, amp, cx, w=1.0):
@@ -104,19 +114,20 @@ def _upd2(Tb, Cpb, p: DiffusionParams):
     return Tb.at[1:-1, 1:-1].add(p.dt * dTdt)
 
 
-def _fresh_mask(shape, j: int):
+def _fresh_mask(shape, retreat):
     """Diffusion's deep-halo sub-step mask: the interior update retreats
-    ``j`` cells per neighbor side — ``[1 + j·L, n-1 - j·R)`` per dim (see
+    ``retreat`` cells per neighbor side (a scalar, or per-dim under a
+    per-axis cadence) — ``[1 + r_d·L, n-1 - r_d·R)`` per dim (see
     `common.fresh_mask` for the shared machinery and the soundness
     argument)."""
     from .common import fresh_mask
 
-    return fresh_mask(shape, j, (1,) * len(shape), (1,) * len(shape))
+    return fresh_mask(shape, retreat, (1,) * len(shape), (1,) * len(shape))
 
 
 def init_diffusion3d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, lz=10.0,
                      dtype=None, overlap=False, sr=False, sr_seed=0,
-                     comm_every=1):
+                     comm_every=None):
     """Build (T, Cp, params) with the reference example's initial conditions
     (two Gaussian anomalies each,
     `diffusion3D_multigpu_CuArrays_novis.jl:34-38`) as stacked sharded arrays.
@@ -141,9 +152,15 @@ def init_diffusion3d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, lz=10.0,
         + 50 * jnp.exp(-(((x - lx / 2) / 2) ** 2) - (((y - ly / 2) / 2) ** 2) - (((z - lz / 1.5) / 2) ** 2))
     T = device_put_g(jnp.broadcast_to(T, Tz.shape).astype(Tz.dtype))
     Cp = device_put_g(jnp.broadcast_to(Cp, Tz.shape).astype(Tz.dtype))
+    from .common import resolve_comm_every
+
+    # comm_every=None consults IGG_COMM_EVERY (the wire-policy env
+    # convention); stored canonically so the params value is hashable and
+    # spelling-independent ("gz:4" and "z:4" build one cached runner)
     return T, Cp, DiffusionParams(lam=lam, dt=dt, dx=dx, dy=dy, dz=dz,
                                   overlap=overlap, sr=sr, sr_seed=sr_seed,
-                                  comm_every=comm_every)
+                                  comm_every=str(resolve_comm_every(
+                                      comm_every)))
 
 
 def init_diffusion2d(*, lam=1.0, cp_min=1.0, lx=10.0, ly=10.0, dtype=None):
@@ -308,13 +325,15 @@ def _resolve_impl(impl, ndim=3):
 
 def _reject_comm_every(p: DiffusionParams, what: str):
     """make_step/make_run advance one exchange per step — silently running
-    them with comm_every > 1 would measure nothing; route to
+    them with a deep cadence would measure nothing; route to
     `make_run_deep`/`run_diffusion` instead (same precedent as sr)."""
-    if p.comm_every > 1:
+    from .common import resolve_comm_every
+
+    if resolve_comm_every(p.comm_every).deep:
         from ..utils.exceptions import InvalidArgumentError
 
         raise InvalidArgumentError(
-            f"DiffusionParams(comm_every={p.comm_every}) needs the "
+            f"DiffusionParams(comm_every={p.comm_every!r}) needs the "
             f"deep-halo runner: use run_diffusion or make_run_deep "
             f"({what} exchanges every step and cannot honor the cadence).")
 
@@ -399,33 +418,61 @@ def make_run_sr(p: DiffusionParams, nt_chunk: int, ndim: int = 3):
                              key=("diffusion_sr", p))
 
 
-def make_run_deep(p: DiffusionParams, nt_chunk_super: int, ndim: int = 3):
-    """Communication-avoiding runner: ONE super-step = ``p.comm_every``
-    masked sub-steps (`_fresh_mask`) + ONE k-wide exchange.
-    ``nt_chunk_super`` counts super-steps (physical steps / k)."""
+def deep_step(p: DiffusionParams, ndim: int = 3):
+    """The communication-avoiding SUPER-STEP as a local step function:
+    ``lcm(k_d)`` masked sub-steps (`_fresh_mask`, per-dim retreats) with
+    each mesh axis's k-wide exchange issued only at the sub-steps its
+    cadence makes it due (`CommCadence.due_dims` — a ``k_d = 1`` axis
+    exchanges every sub-step, a deep axis once per ``k_d``). Validates
+    the grid's halo geometry against the cadence; returns ``(step,
+    cycle)`` where ``step((T, Cp)) -> (T, Cp)`` advances ``cycle``
+    physical steps. The building block of `make_run_deep` and the
+    scheduler's tuned builtin jobs (`service.job.builtin_setup`)."""
     import jax.numpy as jnp
 
-    from .common import make_state_runner, validate_deep_halo
+    from .common import resolve_comm_every, validate_deep_halo
 
     check_initialized()
     gg = global_grid()
-    k = int(p.comm_every)
-    validate_deep_halo(gg, ndim, k)
+    cad = resolve_comm_every(p.comm_every)
+    validate_deep_halo(gg, ndim, cad)
+    K = cad.cycle
 
     upd = _upd3 if ndim == 3 else _upd2
 
     def step(state):
         T, Cp = state
-        for j in range(k):
+        for j in range(K):
             Tn = upd(T, Cp, p)
-            if j:
-                T = jnp.where(_fresh_mask(T.shape, j), Tn, T)
+            r = cad.retreats(j, ndim)
+            if any(r):
+                T = jnp.where(_fresh_mask(T.shape, r), Tn, T)
             else:
-                T = Tn  # sub-step 0 updates the full interior
-        return local_update_halo(T), Cp
+                T = Tn  # all axes fresh: full-interior update
+            due = cad.due_dims(j, ndim)
+            if due:
+                T = local_update_halo(T, dims=due)
+        return T, Cp
 
+    return step, K
+
+
+def make_run_deep(p: DiffusionParams, nt_chunk_super: int, ndim: int = 3,
+                  ensemble: int | None = None):
+    """Communication-avoiding runner: ONE super-step = the per-axis
+    cadence's full cycle of masked sub-steps (`deep_step`), with each
+    axis's k-wide exchange once per ``k_d`` sub-steps.
+    ``nt_chunk_super`` counts super-steps (physical steps / lcm(k_d)).
+    ``ensemble=E`` batches E scenario members through the SAME deep-halo
+    collectives (the vmapped chunk of `make_state_runner(ensemble=)` —
+    XLA tier, like the cadence itself)."""
+    from .common import make_state_runner, resolve_comm_every
+
+    step, _ = deep_step(p, ndim)
+    cad = resolve_comm_every(p.comm_every)
     return make_state_runner(step, (ndim, ndim), nt_chunk=nt_chunk_super,
-                             key=("diffusion_deep", p))
+                             key=("diffusion_deep", p, str(cad), ensemble),
+                             ensemble=ensemble)
 
 
 def run_diffusion(T, Cp, p: DiffusionParams, nt: int, *, nt_chunk: int = 100,
@@ -436,48 +483,66 @@ def run_diffusion(T, Cp, p: DiffusionParams, nt: int, *, nt_chunk: int = 100,
 
     ``ensemble=E`` advances an E-member batch (``T``/``Cp`` lead with the
     member axis — `common.ensemble_state`): one mesh, one set of
-    collectives, E trajectories per step. Plain XLA stepping only
-    (``sr``/``comm_every`` variants are solo-run features)."""
+    collectives, E trajectories per step. Composes with ``comm_every``
+    deep-halo cadences on the XLA tier (the vmapped deep super-step —
+    each batched ppermute now amortizes BOTH ways: E members per payload,
+    1/k_d launches per axis); ``sr=True`` stays a solo-run feature."""
     import jax.numpy as jnp
 
     from ..utils.exceptions import InvalidArgumentError
-    from .common import run_chunked
+    from .common import resolve_comm_every, run_chunked
 
+    cad = resolve_comm_every(p.comm_every)
     if ensemble is not None:
         E = int(ensemble)
-        if p.comm_every > 1 or p.sr:
+        if p.sr:
             raise InvalidArgumentError(
-                "ensemble batching supports the plain XLA step only "
-                "(comm_every > 1 and sr=True are solo-run features).")
+                "ensemble batching does not support sr=True "
+                "(stochastic-rounding storage is a solo-run feature).")
         if T.ndim < 2 or int(T.shape[0]) != E:
             raise InvalidArgumentError(
                 f"ensemble={E} expects T to lead with the member axis "
                 f"(shape (E, ...)); got {tuple(T.shape)} — build the "
                 "state with models.common.ensemble_state.")
         ndim = T.ndim - 1
+        if cad.deep:
+            if impl is not None and not impl.startswith("xla"):
+                raise InvalidArgumentError(
+                    f"impl={impl!r} is incompatible with comm_every="
+                    f"{cad}: deep-halo stepping (batched or solo) runs "
+                    "only the XLA tier.")
+            K = cad.cycle
+            if nt % K:
+                raise InvalidArgumentError(
+                    f"nt={nt} must be a multiple of the cadence cycle "
+                    f"{K} (comm_every={cad} defines the trajectory).")
+            T, Cp = run_chunked(
+                lambda c: make_run_deep(p, c, ndim, ensemble=E),
+                (T, Cp), nt // K, max(1, nt_chunk // K))
+            return T
         T, Cp = run_chunked(
             lambda c: make_run(p, c, ndim, impl, ensemble=E),
             (T, Cp), nt, nt_chunk)
         return T
     ndim = T.ndim
-    if p.comm_every > 1:
+    if cad.deep:
         from ..utils.exceptions import InvalidArgumentError
 
-        k = int(p.comm_every)
         if p.sr and T.dtype == jnp.bfloat16:  # sr is a no-op otherwise
             raise InvalidArgumentError(
-                "comm_every > 1 with sr=True is not supported yet (the "
-                "deep-halo runner has no PRNG threading).")
+                "a deep comm_every cadence with sr=True is not supported "
+                "yet (the deep-halo runner has no PRNG threading).")
         if impl is not None and not impl.startswith("xla"):
             raise InvalidArgumentError(
-                f"impl={impl!r} is incompatible with comm_every={k}: "
+                f"impl={impl!r} is incompatible with comm_every={cad}: "
                 "deep-halo stepping currently runs only the XLA tier.")
-        if nt % k:
+        K = cad.cycle
+        if nt % K:
             raise InvalidArgumentError(
-                f"nt={nt} must be a multiple of comm_every={k} (the "
-                "exchange cadence defines the trajectory).")
+                f"nt={nt} must be a multiple of the cadence cycle {K} "
+                f"(comm_every={cad} defines the trajectory).")
         T, Cp = run_chunked(lambda c: make_run_deep(p, c, ndim),
-                            (T, Cp), nt // k, max(1, nt_chunk // k))
+                            (T, Cp), nt // K, max(1, nt_chunk // K))
         return T
     if p.sr and T.dtype == jnp.bfloat16:
         if impl is not None and not impl.startswith("xla"):

@@ -37,8 +37,8 @@ from ..tools import coords_g, nx_g, ny_g, nz_g
 from .common import make_state_runner, run_chunked
 
 __all__ = ["StokesParams", "init_stokes3d", "stokes_step_local",
-           "make_stokes_run", "make_stokes_run_deep", "run_stokes",
-           "stokes_residuals"]
+           "make_stokes_run", "make_stokes_run_deep", "deep_step",
+           "run_stokes", "stokes_residuals"]
 
 
 @dataclass(frozen=True)
@@ -51,7 +51,13 @@ class StokesParams:
     fields (P, V×3, dV×3 — dV is damped state that the base scheme keeps
     consistent by recomputing it at every face every iteration, so the
     deep scheme must exchange it). One 7-field round per k iterations
-    replaces k 4-field rounds. XLA tier. Trajectory: agrees with the
+    replaces k 4-field rounds. The cadence is PER MESH AXIS
+    (``"z:2,x:1"`` / ``IGG_COMM_EVERY`` — see
+    `DiffusionParams.comm_every`), each axis needing ``halowidths[d] =
+    2*k_d`` / ``overlaps[d] >= 4*k_d``: this is the configuration that
+    rescues the recorded COMM_AVOID.json LOSING row — a z-only cadence
+    amortizes the slow axis's latency without paying the doubled slab
+    compute on the fast axes. XLA tier. Trajectory: agrees with the
     per-iteration-exchange scheme to ~1 ulp per super-step pair on
     XLA:CPU (tests/test_comm_avoid.py asserts <=1e-12 rel with five
     decades of headroom; P stays BIT-exact over one super-step pair).
@@ -83,12 +89,12 @@ class StokesParams:
     dx: float
     dy: float
     dz: float
-    comm_every: int = 1
+    comm_every: int | str = 1
     overlap: bool = False
 
 
 def init_stokes3d(*, mu=1.0, lx=10.0, ly=10.0, lz=10.0, rhog_mag=1.0,
-                  r_incl=1.0, dtype=None, comm_every=1, overlap=False):
+                  r_incl=1.0, dtype=None, comm_every=None, overlap=False):
     """State (P, Vx, Vy, Vz, dVx, dVy, dVz, rhog): zero initial flow, a
     buoyant sphere of radius ``r_incl`` at the domain center."""
     check_initialized()
@@ -118,8 +124,12 @@ def init_stokes3d(*, mu=1.0, lx=10.0, ly=10.0, lz=10.0, rhog_mag=1.0,
     dVy = zeros_g((nx, ny + 1, nz), dtype=dtype)
     dVz = zeros_g((nx, ny, nz + 1), dtype=dtype)
     state = (P, Vx, Vy, Vz, dVx, dVy, dVz, rhog)
+    from .common import resolve_comm_every
+
     return state, StokesParams(mu=mu, dt_v=dt_v, dt_p=dt_p, damp=damp,
-                               dx=dx, dy=dy, dz=dz, comm_every=comm_every,
+                               dx=dx, dy=dy, dz=dz,
+                               comm_every=str(resolve_comm_every(
+                                   comm_every)),
                                overlap=overlap)
 
 
@@ -219,59 +229,86 @@ def stokes_step_local(state, p: StokesParams, impl: str = "xla"):
     return (Pn, Vx, Vy, Vz, dVx, dVy, dVz, rhog)
 
 
-def make_stokes_run_deep(p: StokesParams, nt_chunk_super: int):
-    """Deep-halo PT runner: ONE super-step = ``p.comm_every`` masked
-    iterations + ONE 7-field 2k-wide exchange (P, V×3, dV×3).
+def deep_step(p: StokesParams):
+    """The deep-halo PT SUPER-STEP as a local step function: ``lcm(k_d)``
+    masked iterations with the 7-field 2k-wide exchange (P, V×3, dV×3)
+    fired per axis at its own cadence. Returns ``(step, cycle)``.
 
-    Iteration ``j`` masks (`common.fresh_mask`; the PT dependency radius
-    is 2 per iteration, derived from the pre-update V the terms consume):
-    - P: retreat ``2j`` with base 0 (the base update touches every cell;
-      its V dependencies are ``2(j-1)+2`` deep at iteration j >= 1);
-    - V and dV: retreat ``2j+1`` with base 1 per dim (base region
-      ``at[1:-1]``; they consume THIS iteration's Pn — retreat 2j — plus
-      edge stresses one cell deeper).
-    The masked bands (<= 2k wide after k iterations) are exactly what the
-    2k-wide exchange overwrites; dV joins the exchange because the base
-    scheme keeps its band consistent by recomputing every face every
-    iteration, which the deep scheme's masks skip."""
+    Iteration masks, per dim ``d`` with staleness ``r_d = j mod k_d``
+    (`common.fresh_mask`; the PT dependency radius is 2 per iteration,
+    derived from the pre-update V the terms consume):
+    - P: retreat ``2·r_d`` with base 0 (the base update touches every
+      cell; its V dependencies are ``2(r_d-1)+2`` deep at staleness
+      r_d >= 1);
+    - V and dV: retreat ``2·r_d+1`` where ``r_d >= 1`` (0 on a
+      just-exchanged axis) with base 1 per dim (base region ``at[1:-1]``;
+      they consume THIS iteration's Pn — retreat 2·r_d — plus edge
+      stresses one cell deeper).
+    The masked bands (<= 2·k_d wide between an axis's exchanges) are
+    exactly what that axis's 2k-wide exchange overwrites; dV joins the
+    exchange because the base scheme keeps its band consistent by
+    recomputing every face every iteration, which the deep scheme's
+    masks skip."""
     import jax.numpy as jnp
 
-    from .common import fresh_mask, make_state_runner, validate_deep_halo
+    from .common import (
+        fresh_mask, resolve_comm_every, validate_deep_halo,
+    )
 
     check_initialized()
     gg = global_grid()
-    k = int(p.comm_every)
-    validate_deep_halo(gg, 3, k, depth_per_step=2)
+    cad = resolve_comm_every(p.comm_every)
+    validate_deep_halo(gg, 3, cad, depth_per_step=2)
+    K = cad.cycle
 
     ix = (slice(1, -1),) * 3
 
     def step(state):
         P, Vx, Vy, Vz, dVx, dVy, dVz, rhog = state
-        for j in range(k):
+        for j in range(K):
+            r = cad.retreats(j)
             Pn, divV, Rx, Ry, Rz = _stokes_terms(
                 (P, Vx, Vy, Vz, dVx, dVy, dVz, rhog), p)
-            if j:
-                Pn = jnp.where(fresh_mask(P.shape, 2 * j,
+            if any(r):
+                Pn = jnp.where(fresh_mask(P.shape,
+                                          tuple(2 * x for x in r),
                                           (0, 0, 0), (0, 0, 0)), Pn, P)
             upd = []
             for V, dV, R in ((Vx, dVx, Rx), (Vy, dVy, Ry), (Vz, dVz, Rz)):
                 dV_i = p.damp * dV[ix] + R
                 dVn = dV.at[ix].set(dV_i)
                 Vn = V.at[ix].add(p.dt_v * dV_i)
-                if j:
-                    m = fresh_mask(V.shape, 2 * j + 1,
+                if any(r):
+                    m = fresh_mask(V.shape,
+                                   tuple(2 * x + 1 if x else 0 for x in r),
                                    (1, 1, 1), (1, 1, 1))
                     Vn = jnp.where(m, Vn, V)
                     dVn = jnp.where(m, dVn, dV)
                 upd.append((Vn, dVn))
             (Vx, dVx), (Vy, dVy), (Vz, dVz) = upd
             P = Pn
-        P, Vx, Vy, Vz, dVx, dVy, dVz = local_update_halo(
-            P, Vx, Vy, Vz, dVx, dVy, dVz)
+            due = cad.due_dims(j)
+            if due:
+                P, Vx, Vy, Vz, dVx, dVy, dVz = local_update_halo(
+                    P, Vx, Vy, Vz, dVx, dVy, dVz, dims=due)
         return (P, Vx, Vy, Vz, dVx, dVy, dVz, rhog)
 
+    return step, K
+
+
+def make_stokes_run_deep(p: StokesParams, nt_chunk_super: int,
+                         ensemble: int | None = None):
+    """Deep-halo PT runner: ONE super-step = the cadence cycle of masked
+    iterations (`deep_step`) with per-axis 7-field 2k-wide exchanges.
+    ``ensemble=E`` batches E member realizations through the same deep
+    collectives (XLA tier)."""
+    from .common import make_state_runner, resolve_comm_every
+
+    step, _ = deep_step(p)
+    cad = resolve_comm_every(p.comm_every)
     return make_state_runner(step, (3,) * 8, nt_chunk=nt_chunk_super,
-                             key=("stokes3d_deep", p))
+                             key=("stokes3d_deep", p, str(cad), ensemble),
+                             ensemble=ensemble)
 
 
 def _resolve_impl(impl):
@@ -282,12 +319,14 @@ def _resolve_impl(impl):
 
 def make_stokes_run(p: StokesParams, nt_chunk: int, impl: str | None = None,
                     ensemble: int | None = None):
-    if p.comm_every > 1:
+    from .common import resolve_comm_every
+
+    if resolve_comm_every(p.comm_every).deep:
         from ..utils.exceptions import InvalidArgumentError
 
         raise InvalidArgumentError(
-            f"StokesParams(comm_every={p.comm_every}) needs the deep-halo "
-            "runner: use run_stokes or make_stokes_run_deep "
+            f"StokesParams(comm_every={p.comm_every!r}) needs the "
+            "deep-halo runner: use run_stokes or make_stokes_run_deep "
             "(make_stokes_run exchanges every iteration).")
     if ensemble is not None:
         from .common import resolve_ensemble_impl
@@ -309,30 +348,28 @@ def run_stokes(state, p: StokesParams, nt: int, *, nt_chunk: int = 100,
     ``p.comm_every > 1``, routes through the deep-halo runner.
     ``ensemble=E`` batches E member realizations through one chunk
     (member-stacked state, `common.ensemble_state`; plain XLA tier)."""
-    if ensemble is not None:
-        if p.comm_every > 1:
-            from ..utils.exceptions import InvalidArgumentError
+    from ..utils.exceptions import InvalidArgumentError
+    from .common import resolve_comm_every
 
+    cad = resolve_comm_every(p.comm_every)
+    if cad.deep:
+        if impl is not None and not impl.startswith("xla"):
             raise InvalidArgumentError(
-                "ensemble batching supports the plain XLA PT iteration "
-                "only (comm_every > 1 is a solo-run feature).")
+                f"impl={impl!r} is incompatible with comm_every={cad}: "
+                "deep-halo stepping currently runs only the XLA tier.")
+        K = cad.cycle
+        if nt % K:
+            raise InvalidArgumentError(
+                f"nt={nt} must be a multiple of the cadence cycle {K} "
+                f"(comm_every={cad} defines the trajectory).")
+        E = None if ensemble is None else int(ensemble)
+        return run_chunked(
+            lambda c: make_stokes_run_deep(p, c, ensemble=E), state,
+            nt // K, max(1, nt_chunk // K))
+    if ensemble is not None:
         return run_chunked(
             lambda c: make_stokes_run(p, c, impl, ensemble=int(ensemble)),
             state, nt, nt_chunk)
-    if p.comm_every > 1:
-        from ..utils.exceptions import InvalidArgumentError
-
-        k = int(p.comm_every)
-        if impl is not None and not impl.startswith("xla"):
-            raise InvalidArgumentError(
-                f"impl={impl!r} is incompatible with comm_every={k}: "
-                "deep-halo stepping currently runs only the XLA tier.")
-        if nt % k:
-            raise InvalidArgumentError(
-                f"nt={nt} must be a multiple of comm_every={k} (the "
-                "exchange cadence defines the trajectory).")
-        return run_chunked(lambda c: make_stokes_run_deep(p, c), state,
-                           nt // k, max(1, nt_chunk // k))
     impl = _resolve_impl(impl)
     return run_chunked(lambda c: make_stokes_run(p, c, impl), state, nt,
                        nt_chunk)

@@ -886,6 +886,10 @@ def _plan_from_sig(gg, sig, dims_order, coalesce, wire,
         rec["by_dtype"][key] = rec["by_dtype"].get(key, 0) + b
 
     local_bytes = 0
+    # per-AXIS split of the self-neighbor copy traffic: a per-axis
+    # comm_every cadence amortizes each axis's local swaps at that axis's
+    # own rate, so the oracle needs the split, not just the total
+    local_by_axis: dict = {}
     groups_by_dim = _coalesce_groups(
         gg, fields, hws, [False] * len(fields), dims_order,
         coalesce=coalesce, wire=wire)
@@ -911,7 +915,10 @@ def _plan_from_sig(gg, sig, dims_order, coalesce, wire,
             if i in in_group or not _dim_exchanges(gg, f.shape, hws[i], dim):
                 continue
             if D == 1:  # periodic self-neighbor: local slab swap, no wire
-                local_bytes += 2 * slab_cells(i, dim) * f.dtype.itemsize * E
+                b = 2 * slab_cells(i, dim) * f.dtype.itemsize * E
+                local_bytes += b
+                local_by_axis[AXIS_NAMES[dim]] = (
+                    local_by_axis.get(AXIS_NAMES[dim], 0) + b)
                 continue
             fmt = wire_format_for(f.dtype, wire, dim)
             wd = np.dtype(fmt.dtype if fmt is not None else f.dtype)
@@ -926,6 +933,7 @@ def _plan_from_sig(gg, sig, dims_order, coalesce, wire,
         "ppermutes": sum(r["ppermutes"] for r in axes.values()),
         "wire_bytes": sum(r["wire_bytes"] for r in axes.values()),
         "local_copy_bytes": local_bytes,
+        "local_copy_by_axis": local_by_axis,
     }
 
 
@@ -1000,8 +1008,9 @@ def halo_comm_plan(*fields, dims=None, coalesce=None, wire_dtype=None,
 
     Returns ``{fields, coalesce, wire_dtype, ensemble, axes: {axis:
     {ppermutes, wire_bytes, by_dtype}}, ppermutes, wire_bytes,
-    local_copy_bytes}``. `update_halo` charges exactly this plan to the
-    telemetry registry (``igg_halo_*`` counters) on every call."""
+    local_copy_bytes, local_copy_by_axis}``. `update_halo` charges
+    exactly this plan to the telemetry registry (``igg_halo_*``
+    counters) on every call."""
     check_initialized()
     gg = global_grid()
     dims_order = _normalize_dims_order(dims)

@@ -45,17 +45,163 @@ everywhere else (the CPU mesh measures the XLA slab layout directly).
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 
 import numpy as np
 
 from ..utils.exceptions import InvalidArgumentError
 from .precision import (
-    SCALE_BYTES, decode_scales, dequantize_slab, encode_scales,
-    quant_slab_bytes, quantize_slab,
+    SCALE_BYTES, _AXIS_TOKENS, _DIM_NAMES, decode_scales, dequantize_slab,
+    encode_scales, quant_slab_bytes, quantize_slab,
 )
 
-__all__ = ["WireSchema", "slab_schema", "schema_for_fields"]
+__all__ = ["WireSchema", "slab_schema", "schema_for_fields",
+           "CommCadence", "resolve_comm_every"]
+
+
+# ---------------------------------------------------------------------------
+# per-axis exchange cadence (the comm_every knob's resolved form)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class CommCadence:
+    """Resolved PER-MESH-AXIS exchange cadence: one integer ``k >= 1`` per
+    grid dimension (x, y, z) — the deep-halo ``comm_every`` knob
+    generalized so each mesh axis pays its own collective latency at its
+    own rate (the HiCCL per-link-class idea, arXiv:2408.05962, applied to
+    the cadence axis the way `precision.WirePolicy` applies it to wire
+    precision). Axis ``d`` exchanges once per ``k_d`` steps with
+    ``depth * k_d``-wide slabs; ``k_d = 1`` is the exchange-every-step
+    default. The canonical string form round-trips through
+    `resolve_comm_every` (``"4"`` when uniform, else e.g. ``"z:4"`` —
+    unnamed axes are cadence 1)."""
+
+    per_dim: tuple
+
+    def for_dim(self, dim: int) -> int:
+        """Cadence along grid dimension ``dim`` (dims beyond the cadence
+        — e.g. 2-D fields' missing z — exchange every step)."""
+        if 0 <= int(dim) < len(self.per_dim):
+            return self.per_dim[int(dim)]
+        return 1
+
+    @property
+    def uniform(self):
+        """The single cadence when every dim shares one, else ``None``."""
+        ks = set(self.per_dim)
+        return self.per_dim[0] if len(ks) == 1 else None
+
+    @property
+    def deep(self) -> bool:
+        """Whether any axis runs a deep-halo cadence (``k > 1``)."""
+        return any(k > 1 for k in self.per_dim)
+
+    @property
+    def cycle(self) -> int:
+        """The super-cycle length: lcm of the per-axis cadences — after
+        ``cycle`` sub-steps every axis has just exchanged, so the deep
+        runners' compiled super-step advances exactly this many physical
+        steps."""
+        return math.lcm(*self.per_dim)
+
+    def retreats(self, j: int, ndim: int = 3) -> tuple:
+        """Per-dim staleness at sub-step ``j`` of a super-cycle: the
+        number of sub-steps since the last exchange along each dim
+        (``j mod k_d`` — exchanges land after sub-steps where
+        ``(j+1) % k_d == 0``)."""
+        return tuple(int(j) % self.for_dim(d) for d in range(ndim))
+
+    def due_dims(self, j: int, ndim: int = 3, order=None) -> tuple:
+        """Grid dims whose exchange is due after sub-step ``j``, in the
+        exchange processing order (default z, x, y — the reference's
+        sequential-corner order, `ops.halo.DEFAULT_DIMS_ORDER`)."""
+        if order is None:
+            from .halo import DEFAULT_DIMS_ORDER
+
+            order = DEFAULT_DIMS_ORDER
+        return tuple(d for d in order
+                     if d < ndim and (int(j) + 1) % self.for_dim(d) == 0)
+
+    def __str__(self) -> str:
+        u = self.uniform
+        if u is not None:
+            return str(u)
+        parts = [f"{_DIM_NAMES[d]}:{k}"
+                 for d, k in enumerate(self.per_dim) if k != 1]
+        return ",".join(parts) if parts else "1"
+
+    def __repr__(self) -> str:
+        return f"CommCadence({self})"
+
+
+def _parse_cadence_k(token) -> int:
+    try:
+        k = int(str(token).strip())
+    except (TypeError, ValueError):
+        raise InvalidArgumentError(
+            f"comm_every cadence must be an integer >= 1; got {token!r}.")
+    if k < 1:
+        raise InvalidArgumentError(
+            f"comm_every cadence must be >= 1; got {k}.")
+    return k
+
+
+def resolve_comm_every(comm_every=None) -> CommCadence:
+    """Resolve the requested exchange cadence to a `CommCadence`.
+
+    ``comm_every=None`` consults ``IGG_COMM_EVERY``; an explicit argument
+    wins over the environment. Accepted forms (the `resolve_wire_dtype`
+    spelling family):
+
+    - an integer ``k`` (or its string) — every axis exchanges once per
+      ``k`` steps;
+    - a per-axis spec ``"z:4,x:1"`` (axes ``x``/``y``/``z`` or
+      ``gx``/``gy``/``gz``; unnamed axes stay cadence 1);
+    - a ``{axis: k}`` mapping, or a `CommCadence`.
+
+    The default — no argument, no environment — is the uniform cadence 1
+    (exchange every step)."""
+    import os
+
+    if comm_every is None:
+        comm_every = os.environ.get("IGG_COMM_EVERY")
+    if comm_every is None or comm_every == "":
+        return CommCadence((1, 1, 1))
+    if isinstance(comm_every, CommCadence):
+        return comm_every
+    if isinstance(comm_every, dict):
+        items = list(comm_every.items())
+    elif isinstance(comm_every, str) and ":" in comm_every:
+        items = []
+        for part in comm_every.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" not in part:
+                raise InvalidArgumentError(
+                    f"Per-axis comm_every spec {comm_every!r}: entry "
+                    f"{part!r} must be '<axis>:<k>' (e.g. 'z:4,x:1').")
+            axis, k = part.split(":", 1)
+            items.append((axis, k))
+    else:
+        return CommCadence((_parse_cadence_k(comm_every),) * 3)
+
+    per_dim = [1, 1, 1]
+    seen = set()
+    for axis, k in items:
+        key = str(axis).strip().lower()
+        dim = _AXIS_TOKENS.get(key)
+        if dim is None:
+            raise InvalidArgumentError(
+                f"Unknown mesh axis {axis!r} in comm_every spec (use "
+                "x/y/z or gx/gy/gz).")
+        if dim in seen:
+            raise InvalidArgumentError(
+                f"Mesh axis {axis!r} named twice in comm_every spec.")
+        seen.add(dim)
+        per_dim[dim] = _parse_cadence_k(k)
+    return CommCadence(tuple(per_dim))
 
 
 @dataclass(frozen=True)

@@ -248,6 +248,19 @@ class ResilientRun:
                     raise InvalidArgumentError(
                         f"NaNPoke index {tuple(f.index)} is outside field "
                         f"{f.name!r} of stacked shape {tuple(shape)}.")
+        # auto-tuner application (RunSpec.tuned): resolve once — a bad
+        # path/record must fail construction, not chunk 40 — and scope
+        # the config's trace-time knobs around every advance() so chunk
+        # compiles resolve them (wire dtype / coalescing / cadence are
+        # read from the environment at trace time and key the runner
+        # cache). Structural knobs (overlap, deep cadence in the step
+        # body, ensemble stacking) belong to the setup that built
+        # step_local/state — the scheduler's admission applies those
+        # (`service.job.builtin_setup(tuned=)`).
+        from ..telemetry.tune import resolve_tuned
+
+        self.tuned = resolve_tuned(spec.tuned)
+        self._tuned_env = None if self.tuned is None else self.tuned.env()
         if spec.audit_lints is not None and not spec.audit:
             raise InvalidArgumentError(
                 "audit_lints selects rules for the compile-time audit — it "
@@ -353,6 +366,12 @@ class ResilientRun:
             if model_step_s is not None:
                 record_event("perf_model", step_s=model_step_s,
                              bound=model_bound, source=model_source)
+            if self.tuned is not None:
+                record_event("tuned", model=self.tuned.model,
+                             **self.tuned.knobs(),
+                             predicted_step_s=self.tuned.predicted_step_s,
+                             measured_step_s=self.tuned.measured_step_s,
+                             speedup=self.tuned.speedup)
         except BaseException:
             # a failed setup must not leak the endpoint or the writer
             # thread
@@ -451,7 +470,18 @@ class ResilientRun:
         remain (False once the run is complete). The first call performs
         the initial step-0 checkpoint save; the call that commits step
         ``nt`` records the ``run_end`` event. Preemption between calls is
-        safe — this is the scheduler's slice boundary."""
+        safe — this is the scheduler's slice boundary. With a tuned
+        config attached (`RunSpec.tuned`) every iteration runs under the
+        config's trace-time knob scope, so any chunk compile this call
+        pays resolves the tuned wire/coalesce/cadence environment."""
+        if self._tuned_env is not None:
+            from ..telemetry.tune import _scoped_env
+
+            with _scoped_env(self._tuned_env):
+                return self._advance()
+        return self._advance()
+
+    def _advance(self) -> bool:
         if self._finished:
             return False
         if not self._started:

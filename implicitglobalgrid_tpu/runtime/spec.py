@@ -44,6 +44,14 @@ class RunSpec:
       chunk program; every state array leads with the member axis — build
       with `models.common.ensemble_state` — and the guard trips per
       member)
+    - auto-tuner: ``tuned`` (a `telemetry.TunedConfig`, its JSON dict, or
+      a path to one — `telemetry.tune_config` output). The driver scopes
+      the config's TRACE-TIME knobs (``IGG_COMM_EVERY`` /
+      ``IGG_HALO_WIRE_DTYPE`` / ``IGG_HALO_COALESCE``) around every
+      chunk compile and records a ``tuned`` flight event; the scheduler
+      additionally applies it at ADMISSION (setup runs under the scope,
+      and a tuned ``ensemble`` fills an unset ``RunSpec.ensemble``) —
+      see `service.MeshScheduler` / `service.job.builtin_setup(tuned=)`.
     """
 
     nt_chunk: int = 100
@@ -71,6 +79,7 @@ class RunSpec:
     audit: bool = False
     audit_lints: Any = None
     ensemble: int | None = None
+    tuned: Any = None
 
     def to_json(self) -> dict:
         """JSON-able summary of the NON-DEFAULT, serializable knobs (for

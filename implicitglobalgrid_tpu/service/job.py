@@ -145,10 +145,34 @@ class Job:
 # Built-in model setups (the CLI's JSON-describable jobs)
 # ---------------------------------------------------------------------------
 
-def _setup_diffusion3d(dtype):
-    from ..models import diffusion_step_local, init_diffusion3d
+def _tuned_knobs(cfg) -> dict:
+    """(comm_every, overlap) init keywords from a tuned config (or the
+    defaults)."""
+    if cfg is None:
+        return {"comm_every": 1, "overlap": False}
+    return {"comm_every": cfg.comm_every, "overlap": bool(cfg.overlap)}
 
-    T, Cp, p = init_diffusion3d(dtype=dtype)
+
+def _dict_step(names, tuple_step):
+    """Adapt a tuple-state local step to the driver's dict-state form."""
+    def step(s):
+        out = tuple_step(tuple(s[n] for n in names))
+        return dict(zip(names, out))
+    return step
+
+
+def _setup_diffusion3d(dtype, cfg=None):
+    from ..models import diffusion_step_local, init_diffusion3d
+    from ..models import diffusion as D
+    from ..models.common import resolve_comm_every
+
+    T, Cp, p = init_diffusion3d(dtype=dtype, **_tuned_knobs(cfg))
+    if resolve_comm_every(p.comm_every).deep:
+        # the tuned deep cadence: the job's step is the SUPER-STEP
+        # (lcm(k_d) physical steps + due-axis exchanges per call) — the
+        # JobSpec's nt then counts super-steps
+        sstep, _ = D.deep_step(p)
+        return _dict_step(("T", "Cp"), sstep), {"T": T, "Cp": Cp}
 
     def step(s):
         return {"T": diffusion_step_local(s["T"], s["Cp"], p, "xla"),
@@ -157,9 +181,14 @@ def _setup_diffusion3d(dtype):
     return step, {"T": T, "Cp": Cp}
 
 
-def _setup_diffusion2d(dtype):
+def _setup_diffusion2d(dtype, cfg=None):
     from ..models import diffusion_step_local, init_diffusion2d
+    from ..models.common import resolve_comm_every
 
+    if cfg is not None and resolve_comm_every(cfg.comm_every).deep:
+        raise InvalidArgumentError(
+            "diffusion2d jobs do not support a tuned deep comm_every "
+            "cadence (the 2-D builtin runs the per-step path).")
     T, Cp, p = init_diffusion2d(dtype=dtype)
 
     def step(s):
@@ -169,11 +198,16 @@ def _setup_diffusion2d(dtype):
     return step, {"T": T, "Cp": Cp}
 
 
-def _setup_acoustic3d(dtype):
+def _setup_acoustic3d(dtype, cfg=None):
     from ..models import acoustic_step_local, init_acoustic3d
+    from ..models import acoustic as A
+    from ..models.common import resolve_comm_every
 
-    state, p = init_acoustic3d(dtype=dtype)
+    state, p = init_acoustic3d(dtype=dtype, **_tuned_knobs(cfg))
     names = ("P", "Vx", "Vy", "Vz")
+    if resolve_comm_every(p.comm_every).deep:
+        sstep, _ = A.deep_step(p)
+        return _dict_step(names, sstep), dict(zip(names, state))
 
     def step(s):
         out = acoustic_step_local(tuple(s[n] for n in names), p, "xla")
@@ -182,11 +216,16 @@ def _setup_acoustic3d(dtype):
     return step, dict(zip(names, state))
 
 
-def _setup_stokes3d(dtype):
+def _setup_stokes3d(dtype, cfg=None):
     from ..models import init_stokes3d, stokes_step_local
+    from ..models import stokes as S
+    from ..models.common import resolve_comm_every
 
-    state, p = init_stokes3d(dtype=dtype)
+    state, p = init_stokes3d(dtype=dtype, **_tuned_knobs(cfg))
     names = ("P", "Vx", "Vy", "Vz", "dVx", "dVy", "dVz", "rhog")
+    if resolve_comm_every(p.comm_every).deep:
+        sstep, _ = S.deep_step(p)
+        return _dict_step(names, sstep), dict(zip(names, state))
 
     def step(s):
         out = stokes_step_local(tuple(s[n] for n in names), p, "xla")
@@ -204,7 +243,8 @@ BUILTIN_MODELS = {
 
 
 def builtin_setup(model: str, dtype: str = "float32",
-                  ensemble: int | None = None, perturb: float = 0.0):
+                  ensemble: int | None = None, perturb: float = 0.0,
+                  tuned=None):
     """A `JobSpec.setup` callable for a built-in model family — what
     `tools jobs submit` builds from a JSON job description. The callable
     runs at ADMISSION, under the job's own grid.
@@ -217,11 +257,34 @@ def builtin_setup(model: str, dtype: str = "float32",
     ``RunSpec(ensemble=E)`` so the scheduler's `ResilientRun` vmaps the
     chunk and trips the guard per member. One admitted job then serves E
     scenario users through one set of collectives, with per-member gauges
-    in the job's scoped registry (`hooks.observe_member_health`)."""
+    in the job's scoped registry (`hooks.observe_member_health`).
+
+    ``tuned`` (a `telemetry.TunedConfig` / dict / path — pair it with
+    ``RunSpec(tuned=...)`` so the driver scopes the wire knobs too)
+    applies the auto-tuner's STRUCTURAL knobs at setup: the model is
+    built with the tuned ``overlap`` and ``comm_every``; a deep cadence
+    makes the job's step the deep-halo SUPER-STEP (one call = the
+    cadence cycle of physical steps — size ``nt`` in super-steps and
+    init the job's grid with the cadence's ``halowidths[d] =
+    depth*k_d`` / ``overlaps[d] = 2*depth*k_d``; the tuned config's
+    ``grid.winner`` records exactly that geometry). An unset
+    ``ensemble`` argument inherits the tuned one. A tuned config for a
+    DIFFERENT model raises — silently applying another family's knobs
+    would be a misconfiguration, not a tuning."""
     if model not in BUILTIN_MODELS:
         raise InvalidArgumentError(
             f"Unknown model {model!r}; available: "
             f"{sorted(BUILTIN_MODELS)}.")
+    from ..telemetry.tune import resolve_tuned
+
+    cfg = resolve_tuned(tuned)
+    if cfg is not None and cfg.model != model:
+        raise InvalidArgumentError(
+            f"builtin_setup: tuned config is for model {cfg.model!r}, "
+            f"job runs {model!r} — refusing to apply another family's "
+            "knobs.")
+    if ensemble is None and cfg is not None:
+        ensemble = cfg.ensemble
     if ensemble is not None and int(ensemble) < 1:
         raise InvalidArgumentError(
             f"builtin_setup: ensemble must be >= 1; got {ensemble}.")
@@ -230,7 +293,7 @@ def builtin_setup(model: str, dtype: str = "float32",
     dt = np.dtype(dtype).type
 
     def setup():
-        step, state = BUILTIN_MODELS[model](dt)
+        step, state = BUILTIN_MODELS[model](dt, cfg)
         if ensemble is not None:
             from ..models.common import ensemble_state
 
@@ -240,5 +303,7 @@ def builtin_setup(model: str, dtype: str = "float32",
     setup.__qualname__ = (
         f"builtin_setup({model!r}, {dtype!r}"
         + (f", ensemble={int(ensemble)}" if ensemble is not None else "")
+        + (f", tuned={cfg.comm_every}/{cfg.wire_dtype}"
+           if cfg is not None else "")
         + ")")
     return setup

@@ -341,8 +341,22 @@ class MeshScheduler:
         """First slice grant: build the job's grid over the shared device
         pool, run its setup under that grid, construct its `ResilientRun`.
         All of it streams into the job's own flight recorder; the cost is
-        journaled as ``admit_s`` (the admission analog of a cold chunk)."""
+        journaled as ``admit_s`` (the admission analog of a cold chunk).
+
+        A tuned job (``RunSpec.tuned`` — `telemetry.tune_config` output)
+        is LOADED-AND-APPLIED here: the config's trace-time knobs
+        (``IGG_COMM_EVERY`` / wire dtype / coalescing) scope the setup —
+        so a setup that consults the environment (the builtin model
+        inits do) builds the tuned step — a tuned ``ensemble`` fills an
+        unset ``RunSpec.ensemble`` (the guard then trips per member),
+        and the applied knob set is journaled as ``job_tuned``. The
+        `ResilientRun` keeps scoping the same knobs around every slice's
+        chunk compiles."""
+        import contextlib
+        import dataclasses
+
         from ..parallel.grid import init_global_grid
+        from ..telemetry.tune import _scoped_env, resolve_tuned
 
         t0 = time.monotonic()
         # the gauge scope first: it cannot fail, and the failure path
@@ -353,15 +367,23 @@ class MeshScheduler:
             job.recorder = FlightRecorder(
                 os.path.join(self.flight_dir, f"job_{job.name}.jsonl"),
                 run_id=job.name)
+        run_spec = job.spec.run
+        tuned = resolve_tuned(run_spec.tuned)
+        if tuned is not None and run_spec.ensemble is None \
+                and tuned.ensemble is not None:
+            run_spec = dataclasses.replace(run_spec,
+                                           ensemble=int(tuned.ensemble))
+        knob_scope = (_scoped_env(tuned.env()) if tuned is not None
+                      else contextlib.nullcontext())
         prev = top.swap_global_grid(None)
         try:
             init_global_grid(**{"quiet": True, **job.spec.grid})
             job.gg = top.global_grid()
             top.retain_epoch(job.gg.epoch)
-            with use_flight_recorder(job.recorder):
+            with use_flight_recorder(job.recorder), knob_scope:
                 step_local, state = job.spec.setup()
                 job.run = ResilientRun(step_local, state,
-                                       int(job.spec.nt), job.spec.run)
+                                       int(job.spec.nt), run_spec)
         except BaseException:
             if job.gg is not None:
                 top.release_epoch(job.gg.epoch)
@@ -374,6 +396,9 @@ class MeshScheduler:
         job.started_t = time.time()
         job.admit_s = time.monotonic() - t0
         self._update_queue_gauges()
+        if tuned is not None:
+            self._log("job_tuned", job=job.name, model=tuned.model,
+                      **tuned.knobs(), speedup=tuned.speedup)
         self._log("job_admitted", job=job.name, admit_s=job.admit_s,
                   epoch=int(job.gg.epoch))
 
@@ -454,7 +479,9 @@ class MeshScheduler:
         # igg_member_* series flap between tenants exactly like the perf
         # gauges; the job-labeled copies are the per-scenario surface an
         # operator watches
-        E = getattr(job.spec.run, "ensemble", None)
+        # the RUN's member count (a tuned config may have filled an
+        # unset RunSpec.ensemble at admission — the spec alone is stale)
+        E = None if job.run is None else job.run.ensemble
         if ran_chunk and E:
             members = job.run.reports[-int(E):]
             if len(members) == int(E) and all(

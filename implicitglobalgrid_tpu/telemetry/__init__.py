@@ -46,6 +46,14 @@ reference's whole surface, SURVEY §5.4):
   each bench run to a JSONL history, `perfdb_check` fails metrics that
   regress beyond a threshold vs the trailing window (the ``tools perfdb``
   CLI and `bench_all.py`'s self-gate).
+- `tune` — the CLOSED-LOOP auto-tuner (ISSUE 13 tentpole): `tune_config`
+  searches `predict_step` over per-axis ``comm_every`` x per-axis
+  ``wire_dtype`` x coalesce x overlap x ensemble E (every candidate on
+  its own grid geometry), validates the top candidates with short
+  measured calibration runs, and persists the winning `TunedConfig`
+  next to the machine profile; applied per job via
+  `runtime.RunSpec(tuned=)` / the scheduler's admission / ``tools
+  tune``.
 
 All instrumentation is HOST-side: compiled chunk programs are unchanged
 (`tests/test_hlo_audit.py` proves identical collective and fetch counts)
@@ -74,6 +82,10 @@ from .registry import (
     ScopedRegistry, metrics_registry, reset_metrics,
 )
 from .report import run_report
+from .tune import (
+    TunedConfig, load_tuned_config, resolve_tuned, save_tuned_config,
+    tune_config, tuned_config_path,
+)
 from .server import (
     MetricsServer, metrics_server, start_metrics_server,
     stop_metrics_server,
@@ -96,4 +108,6 @@ __all__ = [
     "default_machine_profile", "load_machine_profile",
     "save_machine_profile", "predict_step", "calibrate_machine",
     "metric_direction", "perfdb_add", "perfdb_check", "perfdb_load",
+    "TunedConfig", "tune_config", "save_tuned_config",
+    "load_tuned_config", "resolve_tuned", "tuned_config_path",
 ]

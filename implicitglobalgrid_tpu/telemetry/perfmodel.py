@@ -174,20 +174,35 @@ class StepWorkload:
     ``None`` means the tiers share the same rounds. Since the fused tier
     rides the canonical wire schema (`ops.wire`), these rounds price —
     and contract-audit — Pallas programs exactly like XLA ones
-    (`groups_for`). Deliberate single-digit precision throughout: the
-    model's job is picking the right regime and being within 2x, not
-    reproducing a cycle simulator."""
+    (`groups_for`).
+
+    ``deep_exchange_groups`` are the rounds of the deep-halo
+    (``comm_every``) runner when they differ from the per-step scheme:
+    the deep super-step exchanges its whole evolving state in ONE
+    coalesced round per due axis (acoustic: one 4-field round replaces
+    the V round + P round; Stokes: one 7-field round incl. dV), and
+    ``deep_halo_depth`` is the scheme's per-sub-step dependency radius
+    (slab width = depth * k_d — 2 for the Stokes PT iteration).
+    Deliberate single-digit precision throughout: the model's job is
+    picking the right regime and being within 2x, not reproducing a
+    cycle simulator."""
 
     flops_per_cell: float
     hbm_passes: float
     exchange_groups: tuple = ((0,),)
     fused_exchange_groups: tuple | None = None
+    deep_exchange_groups: tuple | None = None
+    deep_halo_depth: int = 1
 
-    def groups_for(self, impl: str = "xla") -> tuple:
+    def groups_for(self, impl: str = "xla", deep: bool = False) -> tuple:
         """The exchange rounds of one kernel tier: ``impl="xla"`` (or any
         non-Pallas spelling) prices the XLA step's rounds; a Pallas impl
         prices the fused pass's (same rounds unless the workload declares
-        ``fused_exchange_groups``)."""
+        ``fused_exchange_groups``). ``deep=True`` prices the deep-halo
+        runner's rounds (`deep_exchange_groups` when declared — the
+        cadence tier is XLA-only, so ``deep`` wins over ``impl``)."""
+        if deep and self.deep_exchange_groups is not None:
+            return self.deep_exchange_groups
         if str(impl).startswith("pallas") \
                 and self.fused_exchange_groups is not None:
             return self.fused_exchange_groups
@@ -205,14 +220,20 @@ STEP_WORKLOADS = {
                                 exchange_groups=((0,),)),
     # state (P, Vx, Vy, Vz): the leapfrog exchanges the 3 V fields in one
     # coalesced round, then P in its own round (overlapped when enabled);
-    # the FUSED Pallas pass packs all four fields into ONE round
+    # the FUSED Pallas pass packs all four fields into ONE round, and so
+    # does the deep-halo super-step (per due axis)
     "acoustic3d": StepWorkload(flops_per_cell=20.0, hbm_passes=8.0,
                                exchange_groups=((1, 2, 3), (0,)),
-                               fused_exchange_groups=((0, 1, 2, 3),)),
+                               fused_exchange_groups=((0, 1, 2, 3),),
+                               deep_exchange_groups=((0, 1, 2, 3),)),
     # state (P, Vx, Vy, Vz, dVx, dVy, dVz, rhog): one coalesced round of
-    # the 4 wave fields per PT iteration (models/stokes.py:185)
+    # the 4 wave fields per PT iteration (models/stokes.py:185); the
+    # deep-halo scheme exchanges the 7 evolving fields (dV included) at
+    # radius-2 slabs (StokesParams.comm_every)
     "stokes3d": StepWorkload(flops_per_cell=60.0, hbm_passes=16.0,
-                             exchange_groups=((1, 2, 3, 0),)),
+                             exchange_groups=((1, 2, 3, 0),),
+                             deep_exchange_groups=((0, 1, 2, 3, 4, 5, 6),),
+                             deep_halo_depth=2),
 }
 
 
@@ -229,20 +250,29 @@ def _axis_npairs(gg, dim: int) -> int:
 
 
 def predict_step(model, fields, *, profile: MachineProfile | None = None,
-                 comm_every: int = 1, overlap: bool = False,
+                 comm_every=1, overlap: bool = False,
                  dims=None, coalesce=None, wire_dtype=None,
                  impl: str = "xla", ensemble: int | None = None) -> dict:
     """Predict one step's cost on the CURRENT grid for stacked ``fields``.
 
     ``model`` is a `STEP_WORKLOADS` key or a `StepWorkload`; ``fields``
-    are the stacked state arrays (or anything with shape/dtype) in the
-    model's canonical state order — the workload's ``exchange_groups``
-    index into them to price each exchange round exactly as the step
-    issues it (same argument forms as `halo_comm_plan`).
-    ``profile`` defaults to `default_machine_profile()` (pass a
-    calibrated one for measured coefficients). ``comm_every=k`` prices
-    the deep-halo cadence: the exchange (whose k-wide slabs the fields'
-    halowidths already describe) is charged once per k steps.
+    are the stacked state arrays (or anything with shape/dtype, incl.
+    ``(A, halowidths)`` tuples / `ops.fields.Field` for candidate slab
+    widths) in the model's canonical state order — the workload's
+    ``exchange_groups`` index into them to price each exchange round
+    exactly as the step issues it (same argument forms as
+    `halo_comm_plan`). ``profile`` defaults to
+    `default_machine_profile()` (pass a calibrated one for measured
+    coefficients). ``comm_every`` prices the deep-halo cadence — an int
+    ``k`` or a PER-AXIS spec (``"z:4,x:1"`` / dict / `CommCadence`, the
+    `resolve_comm_every` spelling family): each axis's exchange (whose
+    k_d-wide slabs the fields' halowidths already describe) is charged
+    once per ``k_d`` steps — the latency term divides by THAT axis's
+    cadence, which is exactly the per-link-class amortization the
+    auto-tuner (`telemetry.tune`) searches over. A deep cadence also
+    switches the priced rounds to the deep runner's
+    (`StepWorkload.groups_for(deep=True)` — e.g. acoustic's one 4-field
+    round per due axis instead of the per-step V + P rounds).
     ``overlap`` credits communication that hides behind interior compute
     (the interior-first step shape of `hide_communication` / the
     latency-hiding scheduler). The credit is priced from the slab
@@ -277,10 +307,13 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
     ``bound`` is the largest cost term's class — ``"compute"`` (FLOPs),
     ``"bandwidth"`` (HBM or wire bytes; ``bound_detail`` says which), or
     ``"latency"`` (collective launches) — the knob-picking signal: a
-    latency-bound config wants ``comm_every``/coalescing, a
+    latency-bound config wants ``comm_every``/coalescing (and
+    ``bound_detail`` names the latency-dominant AXIS's knob, e.g.
+    ``comm_every[z]`` — the per-axis cadence the tuner turns), a
     bandwidth-bound one wants ``wire_dtype``, a compute-bound one is
     already at the roofline."""
     from ..ops.halo import halo_comm_plan
+    from ..ops.wire import resolve_comm_every
     from ..parallel.topology import check_initialized, global_grid
 
     check_initialized()
@@ -295,7 +328,7 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
                 f"{sorted(STEP_WORKLOADS)}; or pass a StepWorkload).")
         model_name = str(model)
     profile = profile if profile is not None else default_machine_profile()
-    k = max(1, int(comm_every))
+    cad = resolve_comm_every(comm_every)
     E = 1
     if ensemble is not None:
         E = int(ensemble)
@@ -307,8 +340,8 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
     # in a round coalesce; separate rounds pay separate launches), merged
     # into per-axis totals
     fields = tuple(fields)
-    plan = {"axes": {}, "local_copy_bytes": 0}
-    for group in work.groups_for(impl):
+    plan = {"axes": {}, "local_copy_by_axis": {}}
+    for group in work.groups_for(impl, deep=cad.deep):
         if any(i >= len(fields) for i in group):
             raise InvalidArgumentError(
                 f"predict_step: model {model_name!r} expects at least "
@@ -322,15 +355,16 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
                 axis, {"ppermutes": 0, "wire_bytes": 0})
             dst["ppermutes"] += rec["ppermutes"]
             dst["wire_bytes"] += rec["wire_bytes"]
-        plan["local_copy_bytes"] += sub["local_copy_bytes"]
+        for axis, b in sub["local_copy_by_axis"].items():
+            plan["local_copy_by_axis"][axis] = (
+                plan["local_copy_by_axis"].get(axis, 0) + b)
     # interior cells of the primary (first) field's LOCAL block
-    f0 = fields[0]
-    shape0 = tuple(int(s) for s in f0.shape)
+    shape0 = _shape_of(fields[0])
     local_cells = 1
     for d, s in enumerate(shape0):
         local_cells *= s // int(gg.dims[d]) if d < 3 else s
 
-    itemsize = _itemsize_of(f0)
+    itemsize = _itemsize_of(fields[0])
     # compute scales with the member count; the wire plan above already
     # carries the E x payloads (same launches — the latency term below is
     # the one cost the ensemble does NOT multiply)
@@ -348,17 +382,24 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
         per_link = (rec["wire_bytes"] / npairs) if npairs else 0.0
         coeff = profile.axis(axis)
         pairs = rec["ppermutes"] / 2.0
-        lat_s = pairs * float(coeff.get("latency_s", 0.0)) / k
-        wire_s = per_link / (float(coeff["GBps"]) * 1e9) / k
+        # PER-AXIS amortization: this axis's exchange fires once per its
+        # OWN cadence (the k_d-wide slabs are already in the plan's
+        # bytes, so per-step wire bytes stay flat while launches divide)
+        k_ax = cad.for_dim(axis_dims[axis])
+        lat_s = pairs * float(coeff.get("latency_s", 0.0)) / k_ax
+        wire_s = per_link / (float(coeff["GBps"]) * 1e9) / k_ax
         comm[axis] = {"ppermute_pairs": pairs, "per_link_bytes": per_link,
+                      "comm_every": k_ax,
                       "latency_s": lat_s, "wire_s": wire_s,
                       "s": lat_s + wire_s}
         lat_total += lat_s
         wire_total += wire_s
     # self-neighbor local slab swaps never touch the wire: they are HBM
-    # traffic (read + write) at the memory-bandwidth coefficient
-    local_copy_s = (2.0 * plan["local_copy_bytes"]
-                    / (profile.membw_GBps * 1e9)) / k
+    # traffic (read + write) at the memory-bandwidth coefficient,
+    # amortized per axis like the collectives they stand in for
+    local_copy_s = sum(
+        2.0 * b / (profile.membw_GBps * 1e9) / cad.for_dim(axis_dims[a])
+        for a, b in plan["local_copy_by_axis"].items())
     comm_s = lat_total + wire_total + local_copy_s
     # interior-first overlap credit, priced from the slab geometry: each
     # exchanging dim peels a 2*ol-deep boundary shell off the local block
@@ -388,11 +429,18 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
              "latency_s": "latency", "wire_s": "bandwidth"}[worst]
     detail = {"flops_s": "flops", "hbm_s": "hbm",
               "latency_s": "collective-launch", "wire_s": "wire"}[worst]
+    if worst == "latency_s" and comm:
+        # name the latency-DOMINANT axis's knob: the verdict points at
+        # the per-axis cadence the auto-tuner will actually turn
+        # ("comm_every[z]"), not an undifferentiated global setting
+        dom = max(comm, key=lambda a: comm[a]["latency_s"])
+        detail = f"comm_every[{'xyz'[axis_dims[dom]]}]"
     rec = {
         "model": model_name,
         "profile_source": profile.source,
         "local_cells": local_cells,
         "ensemble": E,
+        "comm_every": str(cad),
         "compute": {"flops": flops, "hbm_bytes": hbm_bytes,
                     "flops_s": flops_s, "hbm_s": hbm_s, "s": compute_s},
         "comm": comm,
@@ -422,11 +470,29 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
     return rec
 
 
+def _unwrap_field(f):
+    """The bare array-like of a `halo_comm_plan`-style field argument:
+    `ops.fields.Field` and ``(A, halowidths)`` tuples (the per-candidate
+    slab-width form the auto-tuner prices with) unwrap to their array."""
+    from ..ops.fields import Field
+
+    if isinstance(f, Field):
+        return f.A
+    if isinstance(f, tuple) and len(f) == 2 and hasattr(f[0], "shape") \
+            and not hasattr(f[1], "shape"):
+        return f[0]
+    return f
+
+
+def _shape_of(f) -> tuple:
+    return tuple(int(s) for s in _unwrap_field(f).shape)
+
+
 def _itemsize_of(f) -> int:
     import numpy as np
 
     try:
-        return int(np.dtype(f.dtype).itemsize)
+        return int(np.dtype(_unwrap_field(f).dtype).itemsize)
     except Exception:
         return 4
 

@@ -46,6 +46,8 @@ _SEQ_FIELDS = {
               "audit_s"),
     "audit_failed": ("error", "audit_s", "attempt"),
     "perf_model": ("step_s", "bound", "source"),
+    "tuned": ("model", "comm_every", "wire_dtype", "coalesce", "overlap",
+              "ensemble", "speedup"),
     "perf_regression": ("chunk", "step_begin", "step_end", "per_step_s",
                         "baseline_s", "z", "ratio"),
     "run_end": ("completed", "chunks"),

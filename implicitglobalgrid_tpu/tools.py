@@ -253,6 +253,15 @@ def _cli(argv=None) -> int:
       FLOP rate, per-mesh-axis link bandwidth/latency) on a
       self-initialized grid and print/persist the JSON the cost model
       (`telemetry.predict_step`) consumes.
+    - ``tune <model> [--profile profile.json] [--out tuned.json]
+      [--cpu] [--nx N] [--no-measure]`` — the closed-loop auto-tuner
+      (`telemetry.tune_config`): search `predict_step` over per-axis
+      ``comm_every`` x per-axis ``wire_dtype`` x coalesce x overlap x
+      ensemble E, validate the top candidates with short measured
+      calibration runs, print (and persist) the winning `TunedConfig`
+      JSON — the file ``jobs submit`` applies per job via the ``tuned``
+      run knob. ``tune show <tuned.json>`` inspects a persisted config
+      host-only.
     - ``audit [model ...] [--hlo FILE] [--json]`` — static analysis of
       compiled programs (`analysis.audit_model` / `audit_program`):
       compile each model's step on a self-initialized grid (``--cpu`` for
@@ -403,6 +412,51 @@ def _cli(argv=None) -> int:
     pdc.add_argument("--min-history", type=int, default=2,
                      help="history points a metric needs before it gates")
     pdc.add_argument("--indent", type=int, default=2)
+    tu = sub.add_parser(
+        "tune", help="closed-loop auto-tuner: search the cost model over "
+                     "comm_every/wire_dtype/coalesce/overlap/ensemble, "
+                     "validate with short measured runs, persist the "
+                     "winning TunedConfig")
+    tu.add_argument("model",
+                    help="model family to tune (diffusion3d, acoustic3d, "
+                         "stokes3d) — or 'show' to inspect a persisted "
+                         "config")
+    tu.add_argument("path", nargs="?", default=None,
+                    help="with 'show': the tuned-config JSON to print")
+    tu.add_argument("--profile", default=None,
+                    help="calibrated MachineProfile JSON "
+                         "(tools calibrate --out); default: grid-derived "
+                         "spec coefficients. A profile path also sets "
+                         "the default persist location (tuned_<model>."
+                         "json next to it)")
+    tu.add_argument("--out", default=None,
+                    help="persist the winning TunedConfig JSON here")
+    tu.add_argument("--nx", type=int, default=32,
+                    help="base local block edge of the tuning grid")
+    tu.add_argument("--cpu", action="store_true",
+                    help="tune on the 8-device virtual CPU mesh (the "
+                         "bench scripts' convention)")
+    tu.add_argument("--no-measure", action="store_true",
+                    help="model-only search (skip the measured "
+                         "validation runs)")
+    tu.add_argument("--top-k", type=int, default=2,
+                    help="predicted candidates to validate with "
+                         "measured runs")
+    tu.add_argument("--comm-every-options", default=None,
+                    help="comma-separated cadence candidates (e.g. "
+                         "'1,2,z:2,z:4'); default: 1, 2, and each "
+                         "exchanging axis's solo cadence")
+    tu.add_argument("--wire-options", default=None,
+                    help="comma-separated wire-policy candidates (e.g. "
+                         "'off,z:int8,z:int8,x:f32' — entries with ':' "
+                         "are kept whole per policy segment; use ';' to "
+                         "separate multi-axis policies)")
+    tu.add_argument("--ensemble-options", default=None,
+                    help="comma-separated ensemble sizes to sweep "
+                         "(e.g. '1,4,8'; 1 = solo)")
+    tu.add_argument("--overlap", action="store_true",
+                    help="include overlap=True candidates")
+    tu.add_argument("--indent", type=int, default=2)
     cal = sub.add_parser(
         "calibrate", help="measure this machine's profile (membw, flops, "
                           "per-axis link bw/latency) for the cost model")
@@ -457,6 +511,14 @@ def _cli(argv=None) -> int:
                           "counts identical to solo with byte-exact "
                           "E-scaled payloads (collective count flat in "
                           "E; XLA tier)")
+    aud.add_argument("--comm-every", default=None,
+                     help="audit the deep-halo SUPER-STEP at this "
+                          "cadence (int or per-axis, e.g. z:2): the "
+                          "compiled cycle's per-axis permute counts and "
+                          "k-wide payload bytes must match the "
+                          "super-cycle contract (the self-initialized "
+                          "grid gets the cadence's halo geometry; XLA "
+                          "tier)")
     aud.add_argument("--no-crosscheck", action="store_true",
                      help="skip the predict_step pricing cross-check")
     aud.add_argument("--json", action="store_true",
@@ -473,6 +535,8 @@ def _cli(argv=None) -> int:
         return _cli_audit(args)
     if args.cmd == "jobs":
         return _cli_jobs(args)
+    if args.cmd == "tune":
+        return _cli_tune(args)
 
     from .telemetry import prometheus_snapshot, run_report
 
@@ -611,6 +675,75 @@ def _cli(argv=None) -> int:
     return 0
 
 
+def _cli_tune(args) -> int:
+    """The ``tune`` subcommand: run the closed-loop auto-tuner on a
+    self-initialized grid (produce mode), or print a persisted config
+    (``tune show tuned.json`` — host-only). Produce mode prints the
+    winning `TunedConfig` JSON; pass ``--out`` (or a ``--profile`` path,
+    whose directory becomes the default home) to persist it where
+    ``jobs submit``'s ``tuned`` run knob can load it."""
+    import json
+    import os
+
+    from .utils.exceptions import InvalidArgumentError
+
+    if args.model == "show":
+        from .telemetry import load_tuned_config
+
+        if not args.path:
+            raise InvalidArgumentError(
+                "tools tune show: name the tuned-config JSON to print.")
+        print(json.dumps(load_tuned_config(args.path).to_json(),
+                         indent=args.indent))
+        return 0
+    if args.path:
+        raise InvalidArgumentError(
+            f"tools tune: unexpected argument {args.path!r} (the "
+            "positional path belongs to 'tune show').")
+
+    def _split(spec):
+        # ';' separates entries so multi-axis policies like
+        # 'z:int8,x:f32' stay whole; a ';'-free spec splits on ','
+        parts = spec.split(";") if ";" in spec else spec.split(",")
+        return tuple(p.strip() for p in parts if p.strip())
+
+    if args.cpu:
+        # must precede any jax device use (the bench scripts' idiom)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from .parallel.topology import dims_create
+    from .telemetry import tune_config
+
+    dims = [int(d) for d in dims_create(len(jax.devices()), (0, 0, 0))]
+    grid = dict(nx=args.nx, ny=args.nx, nz=args.nx,
+                dimx=dims[0], dimy=dims[1], dimz=dims[2],
+                periodx=1, periody=1, periodz=1)
+    kw = {}
+    if args.comm_every_options:
+        kw["comm_every_options"] = _split(args.comm_every_options)
+    if args.wire_options:
+        kw["wire_dtype_options"] = tuple(
+            None if w.lower() in ("off", "none", "") else w
+            for w in _split(args.wire_options))
+    if args.ensemble_options:
+        kw["ensemble_options"] = tuple(
+            None if int(e) <= 1 else int(e)
+            for e in _split(args.ensemble_options))
+    if args.overlap:
+        kw["overlap_options"] = (False, True)
+    cfg = tune_config(args.model, grid, args.profile,
+                      measure=not args.no_measure,
+                      top_k=args.top_k, path=args.out, **kw)
+    print(json.dumps(cfg.to_json(), indent=args.indent))
+    return 0
+
+
 def _cli_jobs(args) -> int:
     """The ``jobs`` subcommand group: the multi-run scheduler's operator
     surface (`docs/service.md`).
@@ -671,11 +804,15 @@ def _cli_jobs(args) -> int:
                     # a batched job is JSON-describable end-to-end: the
                     # RunSpec's ensemble knob also drives the setup's
                     # member stacking ("perturb" ramps the members into
-                    # parameter variants)
+                    # parameter variants), and a "tuned" path applies
+                    # the auto-tuner's knob set on both sides — the
+                    # setup (structural: comm_every/overlap/ensemble)
+                    # and the driver (trace-time: wire/coalesce env)
                     setup=builtin_setup(rec.pop("model"),
                                         rec.pop("dtype", "float32"),
                                         ensemble=run.get("ensemble"),
-                                        perturb=rec.pop("perturb", 0.0)),
+                                        perturb=rec.pop("perturb", 0.0),
+                                        tuned=run.get("tuned")),
                     nt=rec.pop("nt"),
                     grid=dict(rec.pop("grid", {}) or {}),
                     run=RunSpec(**run),
@@ -817,16 +954,35 @@ def _cli_audit(args) -> int:
         if owns_grid:
             dims = [int(d) for d in dims_create(len(jax.devices()),
                                                 (0, 0, 0))]
-            init_global_grid(args.nx, args.nx, args.nx, dimx=dims[0],
+            gkw = {}
+            if args.comm_every is not None:
+                # the cadence's halo geometry: per axis, hw = depth*k_d
+                # (depth 2 when a Stokes program is audited) and the
+                # local block sized to carry it
+                from .ops.wire import resolve_comm_every
+                from .telemetry.perfmodel import STEP_WORKLOADS
+
+                cad = resolve_comm_every(args.comm_every)
+                depth = max((STEP_WORKLOADS[m].deep_halo_depth
+                             for m in args.models
+                             if m in STEP_WORKLOADS), default=1)
+                hw = tuple(depth * cad.for_dim(d) for d in range(3))
+                ol = tuple(2 * h for h in hw)
+                gkw = {"overlaps": ol, "halowidths": hw}
+                nx = [max(args.nx, 2 * o) for o in ol]
+            else:
+                nx = [args.nx] * 3
+            init_global_grid(nx[0], nx[1], nx[2], dimx=dims[0],
                              dimy=dims[1], dimz=dims[2], periodx=1,
-                             periody=1, periodz=1, quiet=True)
+                             periody=1, periodz=1, quiet=True, **gkw)
         try:
             for model in args.models:
                 reports.append((model, audit_model(
                     model, impl=args.impl, wire_dtype=args.wire_dtype,
                     crosscheck=not args.no_crosscheck,
                     optimized=not args.lowered,
-                    ensemble=args.ensemble)))
+                    ensemble=args.ensemble,
+                    comm_every=args.comm_every)))
         finally:
             if owns_grid:
                 finalize_global_grid()

@@ -105,6 +105,39 @@ def test_parse_ensemble_coalesced_fixture():
         routes=_ROUTES, max_payload_cells=4 * 2 * 256)) == []
 
 
+def test_parse_comm_every_mixed_fixture():
+    """Per-axis cadence (ISSUE 13): the deep diffusion SUPER-STEP at
+    ``comm_every="z:2"`` on a dims=(4,1,2) periodic mesh. One compiled
+    super-cycle = 2 physical steps: the x axis exchanges at EVERY
+    sub-step (2 events -> 4 permutes of the 1-wide slab) while the z
+    axis exchanges ONCE with its 2-wide slab (2 permutes) — the per-axis
+    permute counts and k-wide payloads the live contract leg
+    (tests/test_comm_avoid.py) pins against `exchange_contract`."""
+    ir = _fixture("exchange_comm_every_mixed.hlo.txt")
+    assert ir.dialect == "hlo"
+    assert len(ir.permutes) == 6
+    assert not ir.all_reduces and not ir.all_gathers and not ir.all_to_alls
+    # routes of the (4,1,2) mesh in linearized positions (idx = 2x + z)
+    x_fwd = frozenset((2 * x + z, 2 * ((x + 1) % 4) + z)
+                      for x in range(4) for z in range(2))
+    x_bwd = frozenset((2 * x + z, 2 * ((x - 1) % 4) + z)
+                      for x in range(4) for z in range(2))
+    z_ring = frozenset((2 * x + z, 2 * x + (z + 1) % 2)
+                       for x in range(4) for z in range(2))
+    routes = {"gx": (x_fwd, x_bwd), "gz": (z_ring, z_ring)}
+    axes = measure_axes(ir, routes)
+    # x: 2 exchange events x 2 directions, 1-wide slab (8x10 cells,
+    # 320 B) over 8 directed links each; z: ONE event, 2-wide slab
+    # (9x8x2 cells, 576 B) over 8 directed links each
+    assert axes["gx"] == {"permutes": 4, "pairs": 32,
+                          "wire_bytes": 4 * 2560, "dtypes": ("f32",)}
+    assert axes["gz"] == {"permutes": 2, "pairs": 16,
+                          "wire_bytes": 2 * 4608, "dtypes": ("f32",)}
+    for op in ir.permutes:
+        pay = ir.payload_of(op)
+        assert pay.dims in ((1, 8, 10), (9, 8, 2))
+
+
 def test_parse_guarded_chunk_fixture():
     """The guarded 2-field chunk on the 2x2x2 mesh honors the structural
     guard contract host-only: exactly one f32[4] psum, six permutes, no

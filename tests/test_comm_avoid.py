@@ -239,6 +239,253 @@ def test_comm_every2_stokes_equal(periods, n1, n2):
             assert rel < 1e-12, f"{name}: rel {rel:.2e} exceeds ulp budget"
 
 
+# ---------------------------------------------------------------------------
+# per-axis cadence (ISSUE 13): each mesh axis exchanges at its own rate
+# ---------------------------------------------------------------------------
+
+def _stacked_per_dim(n, ol, dims, periods, fn):
+    """`_stacked_from_global_index` with PER-DIM overlaps (per-axis
+    cadence grids mix halo depths, so the mapping needs each dim's own
+    ``n - ol``)."""
+    S = np.zeros(tuple(d * m for d, m in zip(dims, n)))
+
+    def gidx(b, d):
+        g = np.arange(n[d]) + b * (n[d] - ol[d])
+        if periods[d]:
+            g = (g - 1) % (dims[d] * (n[d] - ol[d]))
+        return g
+
+    for bx in range(dims[0]):
+        for by in range(dims[1]):
+            for bz in range(dims[2]):
+                S[bx * n[0]:(bx + 1) * n[0], by * n[1]:(by + 1) * n[1],
+                  bz * n[2]:(bz + 1) * n[2]] = fn(
+                      gidx(bx, 0)[:, None, None],
+                      gidx(by, 1)[None, :, None],
+                      gidx(bz, 2)[None, None, :])
+    return S
+
+
+def _run_per_axis(ln, comm_every, hw, nt, periods=(1, 1, 1)):
+    """Diffusion run under a per-axis cadence grid (halowidths ``hw``,
+    overlaps ``2*hw`` per dim), same implicit global grid convention as
+    `_run` (per dim: ``n - 2*hw`` invariant)."""
+    ol = tuple(2 * h for h in hw)
+    igg.init_global_grid(ln[0], ln[1], ln[2], dimx=2, dimy=2, dimz=2,
+                         periodx=periods[0], periody=periods[1],
+                         periodz=periods[2],
+                         overlaps=ol, halowidths=hw, quiet=True)
+    try:
+        _, _, p = init_diffusion3d(dtype=np.float64,
+                                   comm_every=comm_every)
+        T = igg.device_put_g(_stacked_per_dim(
+            ln, ol, (2, 2, 2), periods,
+            lambda x, y, z: 100 * np.exp(-((x / 7.0 - 1) ** 2)
+                                         - ((y / 5.0 - 1) ** 2)
+                                         - ((z / 6.0 - 1) ** 2))))
+        Cp = igg.device_put_g(_stacked_per_dim(
+            ln, ol, (2, 2, 2), periods,
+            lambda x, y, z: 1.0 + np.exp(-((x / 9.0 - 1) ** 2)
+                                         - ((y / 8.0 - 1) ** 2)
+                                         - ((z / 7.0 - 1) ** 2))))
+        out = run_diffusion(T, Cp, p, nt, nt_chunk=nt)
+        return np.asarray(igg.gather_interior(out))
+    finally:
+        igg.finalize_global_grid()
+
+
+def test_comm_every_per_axis_bitwise_equal():
+    """MIXED cadence ``y:2,z:3`` (cycle 6: the y axis exchanges every 2
+    sub-steps with 2-wide slabs, z every 3 with 3-wide, x every sub-step
+    with 1-wide) reproduces the exchange-every-step trajectory
+    BIT-EXACTLY — each axis's masked retreat advances at its own
+    staleness and its k-wide exchange overwrites exactly the cells that
+    axis's masks skipped."""
+    nt = 6  # one full cadence cycle
+    a = _run_per_axis((8, 8, 8), 1, (1, 1, 1), nt)
+    b = _run_per_axis((8, 10, 12), "y:2,z:3", (1, 2, 3), nt)
+    assert a.shape == b.shape
+    assert np.array_equal(a, b), (
+        f"max diff {np.max(np.abs(a - b))} — per-axis deep-halo "
+        "trajectory diverged")
+
+
+def test_comm_every_per_axis_spelling_matches_uniform():
+    """The uniform-k path and the SAME cadence spelled per-axis build
+    identical trajectories on one grid — the two spellings are one
+    scheme, not two implementations."""
+    nt = 4
+    a = _run_per_axis((10, 10, 10), 2, (2, 2, 2), nt)
+    b = _run_per_axis((10, 10, 10), "x:2,y:2,z:2", (2, 2, 2), nt)
+    assert np.array_equal(a, b)
+
+
+def test_comm_every_per_axis_ensemble():
+    """ROADMAP ensemble rung d: the deep-halo cadence composes with the
+    member axis on the XLA tier — every batched member's trajectory is
+    bit-identical to its solo deep run, and the unsupported combos stay
+    loud."""
+    from implicitglobalgrid_tpu.models.common import ensemble_state
+
+    igg.init_global_grid(9, 9, 10, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1,
+                         overlaps=(4, 4, 4), halowidths=(2, 2, 2),
+                         quiet=True)
+    try:
+        T, Cp, p = init_diffusion3d(dtype=np.float32, comm_every=2)
+        solo = run_diffusion(T, Cp, p, 4, nt_chunk=4)
+        E = 2
+        Tb, Cpb = ensemble_state((T, Cp), E)
+        out = run_diffusion(Tb, Cpb, p, 4, nt_chunk=4, ensemble=E)
+        for m in range(E):
+            assert np.array_equal(np.asarray(out[m]), np.asarray(solo)), (
+                f"member {m} diverged from the solo deep run")
+        with pytest.raises(InvalidArgumentError):
+            run_diffusion(Tb, Cpb, p, 4, ensemble=E, impl="pallas")
+        import dataclasses
+
+        p_sr = dataclasses.replace(p, sr=True)
+        with pytest.raises(InvalidArgumentError):
+            run_diffusion(Tb, Cpb, p_sr, 4, ensemble=E)
+    finally:
+        igg.finalize_global_grid()
+
+
+@pytest.mark.slow
+def test_comm_every_per_axis_acoustic_bitwise_equal():
+    """The staggered leapfrog under a z-only cadence: per-dim V/P
+    retreats at per-axis staleness, 4-field exchange on the due axes
+    only — still bit-identical."""
+    from implicitglobalgrid_tpu.models import init_acoustic3d, run_acoustic
+
+    def run(ln, ce, hw, nt=8):
+        ol = tuple(2 * h for h in hw)
+        igg.init_global_grid(ln[0], ln[1], ln[2], dimx=2, dimy=2, dimz=2,
+                             periodx=1, periody=0, periodz=1,
+                             overlaps=ol, halowidths=hw, quiet=True)
+        try:
+            state, p = init_acoustic3d(dtype=np.float64, comm_every=ce)
+            P = igg.device_put_g(_stacked_per_dim(
+                ln, ol, (2, 2, 2), (1, 0, 1),
+                lambda x, y, z: np.exp(-((x / 7.0 - 1) ** 2)
+                                       - ((y / 5.0 - 1) ** 2)
+                                       - ((z / 6.0 - 1) ** 2))))
+            state = (P.astype(state[0].dtype), *state[1:])
+            out = run_acoustic(state, p, nt, nt_chunk=nt)
+            return [np.asarray(igg.gather_interior(f)) for f in out]
+        finally:
+            igg.finalize_global_grid()
+
+    a = run((8, 8, 8), 1, (1, 1, 1))
+    b = run((8, 8, 10), "z:2", (1, 1, 2))
+    for fa, fb, name in zip(a, b, ("P", "Vx", "Vy", "Vz")):
+        assert np.array_equal(fa, fb), (
+            f"{name} diverged: max {np.max(np.abs(fa - fb))}")
+
+
+@pytest.mark.slow
+def test_comm_every_per_axis_stokes_equal():
+    """The COMM_AVOID.json rescue configuration: a z-only Stokes cadence
+    (halowidths (2,2,4) — the radius-2 scheme needs depth 2 even on
+    cadence-1 axes) agrees with the per-iteration-exchange scheme to the
+    documented ulp budget."""
+    from implicitglobalgrid_tpu.models import init_stokes3d, run_stokes
+
+    def run(ln, ce, hw, nt=4):
+        ol = tuple(2 * h for h in hw)
+        igg.init_global_grid(ln[0], ln[1], ln[2], dimx=2, dimy=2, dimz=2,
+                             periodx=0, periody=0, periodz=0,
+                             overlaps=ol, halowidths=hw, quiet=True)
+        try:
+            state, p = init_stokes3d(dtype=np.float64, comm_every=ce)
+            rhog = igg.device_put_g(_stacked_per_dim(
+                ln, ol, (2, 2, 2), (0, 0, 0),
+                lambda x, y, z: np.exp(-((x / 6.0 - 1) ** 2)
+                                       - ((y / 5.0 - 1) ** 2)
+                                       - ((z / 7.0 - 1) ** 2))))
+            state = (*state[:7], rhog.astype(state[7].dtype))
+            out = run_stokes(state, p, nt, nt_chunk=nt)
+            return [np.asarray(igg.gather_interior(f)) for f in out]
+        finally:
+            igg.finalize_global_grid()
+
+    a = run((9, 9, 9), 1, (1, 1, 1))
+    b = run((10, 10, 12), "z:2", (2, 2, 4))
+    names = ("P", "Vx", "Vy", "Vz", "dVx", "dVy", "dVz", "rhog")
+    for fa, fb, name in zip(a, b, names):
+        if name.startswith("dV"):
+            continue  # halo copies undefined in the base scheme (above)
+        if name == "rhog":
+            assert np.array_equal(fa, fb)
+        else:
+            scale = max(1e-30, np.abs(fa).max())
+            rel = np.max(np.abs(fa - fb)) / scale
+            assert rel < 1e-12, f"{name}: rel {rel:.2e}"
+
+
+@pytest.mark.audit
+def test_comm_every_per_axis_contract_byte_exact():
+    """ISSUE 13 acceptance: the compiled mixed-cadence super-step issues
+    EXACTLY the planned per-axis permute counts and wire bytes — cadence
+    alone, and composed with the per-axis quantized wire policy
+    ``z:int8,x:f32`` (`audit_model(comm_every=)`: contract +
+    `perfmodel_crosscheck` both byte-exact)."""
+    from implicitglobalgrid_tpu.analysis import audit_model
+
+    igg.init_global_grid(9, 9, 10, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1,
+                         overlaps=(2, 2, 4), halowidths=(1, 1, 2),
+                         quiet=True)
+    try:
+        rep = audit_model("diffusion3d", comm_every="z:2")
+        assert rep.ok, [f.message for f in rep.findings]
+        cc = rep.crosscheck
+        assert cc["ok"] and cc["comm_every"] == "z:2"
+        # the cycle (2 steps): x/y fire twice (2 pairs), z once (1 pair)
+        assert cc["axes"]["gx"]["parsed_pairs"] == 2.0
+        assert cc["axes"]["gz"]["parsed_pairs"] == 1.0
+        assert (cc["axes"]["gz"]["modeled_wire_bytes"]
+                == cc["axes"]["gz"]["parsed_wire_bytes"])
+        # composed with the per-axis wire policy: z ships quantized
+        # int8+scale payloads at its own cadence, x stays exact f32
+        rep_q = audit_model("diffusion3d", comm_every="z:2",
+                            wire_dtype="z:int8,x:f32")
+        assert rep_q.ok, [f.message for f in rep_q.findings]
+        assert rep_q.crosscheck["ok"]
+        assert (rep_q.crosscheck["axes"]["gz"]["parsed_wire_bytes"]
+                < cc["axes"]["gz"]["parsed_wire_bytes"])
+    finally:
+        igg.finalize_global_grid()
+
+
+def test_comm_every_per_axis_validation():
+    """Per-axis halo-geometry checks fire per AXIS: a grid whose z halos
+    cannot carry the z cadence is rejected even when x/y are fine, and
+    malformed cadence spellings fail loudly."""
+    from implicitglobalgrid_tpu.models.common import resolve_comm_every
+
+    with pytest.raises(InvalidArgumentError):
+        resolve_comm_every("w:2")
+    with pytest.raises(InvalidArgumentError):
+        resolve_comm_every("z:0")
+    with pytest.raises(InvalidArgumentError):
+        resolve_comm_every("z:2,gz:4")  # one axis named twice
+    assert str(resolve_comm_every("gz:3")) == "z:3"
+    assert resolve_comm_every({"z": 4, "x": 2}).cycle == 4
+    igg.init_global_grid(9, 9, 9, dimx=2, dimy=2, dimz=2,
+                         overlaps=(4, 4, 2), halowidths=(2, 2, 1),
+                         quiet=True)
+    try:
+        T, Cp, p = init_diffusion3d(dtype=np.float64, comm_every="z:2")
+        with pytest.raises(IncoherentArgumentError):
+            run_diffusion(T, Cp, p, 4)  # z halo too shallow for z:2
+        T, Cp, p = init_diffusion3d(dtype=np.float64, comm_every="x:2")
+        out = run_diffusion(T, Cp, p, 4, nt_chunk=4)  # x carries it
+        assert np.isfinite(np.asarray(out)).all()
+    finally:
+        igg.finalize_global_grid()
+
+
 def test_comm_every_validation():
     igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2, quiet=True)
     try:

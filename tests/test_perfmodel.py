@@ -105,6 +105,57 @@ def test_comm_every_and_overlap_pricing():
     assert p1["interior_frac"] == 1.0  # no overlap: nothing serializes
 
 
+def test_per_axis_comm_every_pricing():
+    """ISSUE 13: the latency term divides by EACH axis's own cadence —
+    a z-only cadence amortizes only the z axis while x/y keep their
+    per-step launches — and the record carries the canonical cadence."""
+    _init()
+    T, Cp = igg.ones_g(dtype=np.float32), igg.ones_g(dtype=np.float32)
+    prof = _profile(lat=1e-3)
+    p1 = igg.predict_step("diffusion3d", (T, Cp), profile=prof)
+    pz = igg.predict_step("diffusion3d", (T, Cp), profile=prof,
+                          comm_every="z:4")
+    assert pz["comm_every"] == "z:4"
+    assert pz["comm"]["gz"]["comm_every"] == 4
+    assert pz["comm"]["gz"]["latency_s"] == pytest.approx(
+        p1["comm"]["gz"]["latency_s"] / 4)
+    for ax in ("gx", "gy"):
+        assert pz["comm"][ax]["latency_s"] == pytest.approx(
+            p1["comm"][ax]["latency_s"])
+    # every accepted spelling resolves to one pricing
+    assert igg.predict_step("diffusion3d", (T, Cp), profile=prof,
+                            comm_every={"gz": 4}) == pz
+    # a deep cadence switches acoustic to the deep runner's ONE 4-field
+    # round per due axis (vs the per-step V + P rounds)
+    state = tuple(igg.ones_g(dtype=np.float32) for _ in range(4))
+    a1 = igg.predict_step("acoustic3d", state, profile=prof)
+    a2 = igg.predict_step("acoustic3d", state, profile=prof,
+                          comm_every=2)
+    assert a1["comm"]["gz"]["ppermute_pairs"] == 2.0
+    assert a2["comm"]["gz"]["ppermute_pairs"] == 1.0
+
+
+def test_bound_detail_names_latency_dominant_axis():
+    """A latency-bound verdict points at the AXIS whose cadence the
+    tuner should turn (``comm_every[z]``), not an undifferentiated
+    global knob — the hierarchical ICI+DCN case the per-axis cadence
+    exists for."""
+    _init()
+    T, Cp = igg.ones_g(dtype=np.float32), igg.ones_g(dtype=np.float32)
+    prof = igg.MachineProfile(
+        membw_GBps=1e3, flops_G=1e6,
+        axes={"gx": {"GBps": 45.0, "latency_s": 5e-6},
+              "gy": {"GBps": 45.0, "latency_s": 5e-6},
+              "gz": {"GBps": 45.0, "latency_s": 5e-3}})
+    p = igg.predict_step("diffusion3d", (T, Cp), profile=prof)
+    assert p["bound"] == "latency"
+    assert p["bound_detail"] == "comm_every[z]"
+    # amortizing exactly that axis melts the verdict's latency term
+    pz = igg.predict_step("diffusion3d", (T, Cp), profile=prof,
+                          comm_every="z:8")
+    assert pz["comm_s"] < p["comm_s"]
+
+
 def test_wire_dtype_halves_wire_bytes():
     _init()
     T = igg.ones_g(dtype=np.float32)
