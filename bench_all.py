@@ -244,6 +244,21 @@ def main() -> None:
                          if r["metric"] == "tuned_vs_default_speedup")
     tuned_ok = tuned_speedup is not None and tuned_speedup >= 1.0
 
+    # --- on-device elastic resharding (ISSUE 14) ---------------------------
+    # resize downtime of the HBM-to-HBM collective re-block vs the
+    # checkpoint (disk) path it replaces: the on-device path must never
+    # lose (absolute gate `reshard_vs_disk_speedup >= 1.0` under
+    # IGG_BENCH_STRICT; downtimes + one-time compile ride the perfdb
+    # trajectory). Config owned by `bench_reshard.run_reshard_ab`.
+    import bench_reshard
+
+    reshard_rows = bench_reshard.run_reshard_ab(dims3, cpu)
+    for row in reshard_rows:
+        results.append(bench_util.emit(row))
+    reshard_speedup = next(r["value"] for r in reshard_rows
+                           if r["metric"] == "reshard_vs_disk_speedup")
+    reshard_ok = reshard_speedup is None or reshard_speedup >= 1.0
+
     # --- multi-run scheduler: steady-state multiplexing overhead -----------
     # warm per-slice time of a two-job round_robin scheduler (every slice
     # a context switch) vs a bare warm ResilientRun loop; target < 2%,
@@ -324,7 +339,7 @@ def main() -> None:
         json.dump(results, f, indent=1)
     lint_failed = not ruff_missing and lint.returncode != 0
     if (not gate["ok"] or lint_failed or not coalesce8_ok
-            or not ensemble_ok or not tuned_ok) \
+            or not ensemble_ok or not tuned_ok or not reshard_ok) \
             and os.environ.get("IGG_BENCH_STRICT") == "1":
         sys.exit(1)
 

@@ -65,6 +65,10 @@ from .runtime import (
     NaNPoke, CheckpointCorruption, ProcessLoss,
     poke_nan, corrupt_checkpoint, elastic_restart,
 )
+from . import reshard
+from .reshard import (
+    ReshardPlan, build_reshard_plan, reshard_contract, reshard_state,
+)
 from .telemetry import (
     MetricsRegistry, metrics_registry, reset_metrics, prometheus_snapshot,
     FlightRecorder, start_flight_recorder, stop_flight_recorder,
@@ -75,7 +79,7 @@ from .telemetry import (
     metrics_server,
     MachineProfile, StepWorkload, PerfWatch, default_machine_profile,
     load_machine_profile, save_machine_profile, predict_step,
-    calibrate_machine, perfdb_add, perfdb_check,
+    predict_reshard, calibrate_machine, perfdb_add, perfdb_check,
     TunedConfig, tune_config, save_tuned_config, load_tuned_config,
 )
 from .models.common import ensemble_partition_spec, ensemble_state
@@ -122,6 +126,9 @@ __all__ = [
     # multi-run scheduler (the mesh as a persistent simulation service)
     "service", "MeshScheduler", "JobSpec", "JobState", "service_report",
     "export_service_trace",
+    # on-device elastic resharding (HBM-to-HBM re-blocking, no disk)
+    "reshard", "ReshardPlan", "build_reshard_plan", "reshard_contract",
+    "reshard_state",
     # telemetry (metrics registry, flight recorder, exporters, run report)
     "MetricsRegistry", "metrics_registry", "reset_metrics",
     "prometheus_snapshot", "FlightRecorder", "start_flight_recorder",
@@ -137,8 +144,8 @@ __all__ = [
     # detection, perf-history gate)
     "MachineProfile", "StepWorkload", "PerfWatch",
     "default_machine_profile", "load_machine_profile",
-    "save_machine_profile", "predict_step", "calibrate_machine",
-    "perfdb_add", "perfdb_check",
+    "save_machine_profile", "predict_step", "predict_reshard",
+    "calibrate_machine", "perfdb_add", "perfdb_check",
     # closed-loop auto-tuner (search the oracle, validate with measured
     # runs, persist, apply per job)
     "TunedConfig", "tune_config", "save_tuned_config",

@@ -1,0 +1,37 @@
+"""On-device elastic resharding (ISSUE 14 tentpole).
+
+An elastic ``dims`` change as a COLLECTIVE PROGRAM instead of a disk
+round-trip: `build_reshard_plan` derives the exact block-overlap
+transfer plan from the same implicit-global-grid block-coordinate
+arithmetic the checkpoint-based elastic restore uses
+(`utils.checkpoint.AxisRedistribution` — one copy of the math), schedules
+it into partial-permutation rounds with bounded peak HBM, and
+`reshard_state` compiles and runs it as a sequence of ``lax.ppermute``
+slice rounds over a flat mesh spanning both decompositions — the live
+state re-blocks HBM-to-HBM, bit-identical to `restore_checkpoint_elastic`
+(which stays the verified fallback and the oracle).
+
+The program is contract-audited (`reshard_contract` /
+`audit_reshard_program`; golden fixture in tests/data/hlo/), priced
+statically (`telemetry.predict_reshard`), surfaced in the driver as
+`runtime.ResilientRun.resize(dims)` and in the scheduler as
+`service.MeshScheduler.resize` / ``tools jobs resize`` — the
+autoscaling primitive (ROADMAP "On-device elastic resharding").
+"""
+
+from .plan import (
+    Piece, ReshardPlan, Round, SigPlan, apply_plan_host,
+    build_reshard_plan, fields_of_state, live_topology, reshard_contract,
+)
+from .program import (
+    audit_reshard_program, clear_program_cache, compile_reshard_program,
+    reshard_state,
+)
+
+__all__ = [
+    "ReshardPlan", "SigPlan", "Round", "Piece",
+    "build_reshard_plan", "live_topology", "fields_of_state",
+    "apply_plan_host", "reshard_contract",
+    "reshard_state", "compile_reshard_program", "audit_reshard_program",
+    "clear_program_cache",
+]

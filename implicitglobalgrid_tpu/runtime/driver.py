@@ -228,12 +228,6 @@ class ResilientRun:
                 raise InvalidArgumentError(
                     f"Fault {f} is outside the run's step range "
                     f"[0, {self.nt}).")
-            if isinstance(f, ProcessLoss) and self.ensemble is not None:
-                raise InvalidArgumentError(
-                    "ProcessLoss (elastic restart) is not supported for "
-                    "ensemble runs yet: the elastic redistribution "
-                    "reasons over the 3 spatial axes and would remap the "
-                    "member axis.")
             if isinstance(f, NaNPoke):
                 if f.name not in state:
                     raise InvalidArgumentError(
@@ -261,6 +255,12 @@ class ResilientRun:
 
         self.tuned = resolve_tuned(spec.tuned)
         self._tuned_env = None if self.tuned is None else self.tuned.env()
+        # re-tune trigger (ROADMAP tuner rung c): an elastic resize or a
+        # PerfWatch drift flag invalidates the applied config — the
+        # driver marks it stale (`tuned_stale` flight event) and the
+        # scheduler clears it at the next slice boundary
+        self.tuned_stale = False
+        self.tuned_stale_reason = None
         if spec.audit_lints is not None and not spec.audit:
             raise InvalidArgumentError(
                 "audit_lints selects rules for the compile-time audit — it "
@@ -462,6 +462,162 @@ class ResilientRun:
         raise ResilienceError(
             "Elastic restart failed on every checkpoint slot:\n  "
             + "\n  ".join(errors))
+
+    # -- elastic resize (ISSUE 14: the autoscaling primitive) ---------------
+
+    def resize(self, new_dims, *, via: str = "auto") -> dict:
+        """Re-block the run onto a ``new_dims`` decomposition of the SAME
+        implicit global grid, between `advance()` calls (the scheduler's
+        slice boundary). Two paths, one result:
+
+        - ``"device"`` — the on-device fast path (`reshard.reshard_state`):
+          the live state re-blocks HBM-to-HBM through a contract-audited
+          collective program (sequence of ppermute slice rounds), no disk
+          round-trip. Single-controller; with ``RunSpec.audit`` the
+          program is statically audited against its plan-derived contract
+          (an ``audit`` event with ``program="reshard"``).
+        - ``"checkpoint"`` — the verified fallback and bit-identity
+          oracle: save the live state to the slots, then
+          `restore_checkpoint_elastic` onto the new decomposition (the
+          `ProcessLoss` recovery machinery, minus the lost steps — the
+          live state is the save, so nothing recomputes).
+
+        ``"auto"`` (default) tries the device path and falls back. Both
+        paths end BIT-IDENTICAL (the plan reuses the elastic restore's
+        owner-map arithmetic verbatim; asserted in tests/test_reshard.py),
+        so the trajectory after a resize equals the unresized run's.
+        Afterwards the slots re-anchor on the new decomposition, the
+        rebuilt chunk programs get fresh audit budgets, the
+        ``igg_reshard_{bytes,seconds,rounds}`` metrics and a ``resize``
+        flight event record the move, and an applied `TunedConfig` is
+        marked stale (``tuned_stale`` event — it was tuned for the OLD
+        geometry). Returns the resize record (``via``, ``seconds``,
+        plan stats)."""
+        from ..parallel.topology import global_grid
+        from ..telemetry.hooks import observe_audit, observe_reshard, \
+            record_health_event
+        from ..utils.exceptions import InvalidArgumentError, ResilienceError
+
+        if via not in ("auto", "device", "checkpoint"):
+            raise InvalidArgumentError(
+                f"resize: via must be auto|device|checkpoint; got {via!r}.")
+        if self._finished:
+            raise InvalidArgumentError(
+                "resize: the run already completed all its steps.")
+        new_dims = tuple(int(d) for d in new_dims)
+        if len(new_dims) != 3:
+            raise InvalidArgumentError(
+                f"resize: new_dims must be 3 ints; got {new_dims}.")
+        gg = global_grid()
+        if tuple(int(d) for d in gg.dims) == new_dims:
+            self._record_event("resize", via="noop",
+                               new_dims=list(new_dims), step=self.step)
+            return {"via": "noop", "new_dims": list(new_dims)}
+        # argument-level feasibility FIRST: dims that cannot decompose
+        # the implicit global grid (raises IncoherentArgumentError) or
+        # that exceed the device pool fail the checkpoint path
+        # identically — and the elastic fallback tears the live grid
+        # down before its init would fail, so reaching it with an
+        # infeasible request would leave the run DEAD, not rejected
+        from ..reshard import live_topology
+        from ..reshard.plan import device_pool, restore_topology
+        from ..utils.checkpoint import elastic_local_size
+
+        src_topo = live_topology(gg)
+        elastic_local_size(src_topo, new_dims)
+        pool = device_pool(gg)
+        n_new = new_dims[0] * new_dims[1] * new_dims[2]
+        if n_new > len(pool):
+            raise InvalidArgumentError(
+                f"resize: new_dims {new_dims} need {n_new} device(s); "
+                f"{len(pool)} available.")
+        t0 = time.monotonic()
+        info: dict = {}
+        used = device_error = None
+        if via in ("auto", "device"):
+            try:
+                from ..reshard import reshard_state
+
+                self.state, info = reshard_state(
+                    self.state, new_dims, audit=self.spec.audit,
+                    lints=self.spec.audit_lints)
+                used = "device"
+            except Exception as e:
+                if via == "device":
+                    raise
+                device_error = f"{type(e).__name__}: {e}"
+        if used is None:
+            if self.slots is None:
+                raise ResilienceError(
+                    f"resize to {new_dims}: no checkpoint_dir is "
+                    "configured for the elastic (checkpoint) path"
+                    + (f", and the on-device path failed "
+                       f"({device_error})" if device_error else "")
+                    + ".")
+            # anchor the LIVE state first: the checkpoint path re-blocks
+            # the last save, which must be this exact boundary's state
+            self._save(self.state, self.step)
+            try:
+                self.state, self.step = self._elastic_recover(new_dims)
+            except BaseException:
+                # the elastic restart finalizes + re-inits BEFORE
+                # restoring: a total restore failure (every slot
+                # unreadable) would otherwise leave the grid on
+                # new_dims with old-dims state — put the SOURCE grid
+                # back so a caller treating this as a rejected request
+                # (the scheduler) keeps the tenant alive
+                restore_topology(src_topo, quiet=True)
+                raise
+            used = "checkpoint"
+        dur = time.monotonic() - t0
+        report = info.pop("audit_report", None)
+        if report is not None:
+            observe_audit(report, program="reshard")
+        if info.get("audit_error"):
+            self._record_event("audit_failed", program="reshard",
+                               error=info.pop("audit_error"))
+        # the rebuilt decomposition's chunk programs get fresh audits —
+        # and the slots re-anchor so any later rollback stays on the
+        # live grid (same rule as the elastic restart)
+        self._audited_ns.clear()
+        self._audit_fail_counts.clear()
+        if self.slots is not None:
+            self._save(self.state, self.step)
+        record_health_event("resizes")
+        observe_reshard(
+            dur, via=used, new_dims=list(new_dims), step=self.step,
+            rounds=info.get("rounds"), wire_bytes=info.get("wire_bytes"),
+            local_bytes=info.get("local_bytes"),
+            peak_payload_bytes=info.get("peak_payload_bytes"),
+            **({"device_error": device_error} if device_error else {}))
+        self._mark_tuned_stale("resize")
+        return {"via": used, "seconds": dur, "new_dims": list(new_dims),
+                **({"device_error": device_error} if device_error else {}),
+                **info}
+
+    def _mark_tuned_stale(self, reason: str) -> None:
+        """Flag the applied `TunedConfig` as invalidated (a resize changed
+        the geometry it was searched for; a PerfWatch drift says its
+        knobs stopped winning). No-op without a tuned config; records the
+        ``tuned_stale`` flight event once."""
+        if self.tuned is None or self.tuned_stale:
+            return
+        self.tuned_stale = True
+        self.tuned_stale_reason = reason
+        self._record_event("tuned_stale", reason=reason,
+                           model=self.tuned.model)
+
+    def clear_tuned(self) -> None:
+        """Drop the applied `TunedConfig` (the scheduler's stale-config
+        reaction at a slice boundary): subsequent chunk compiles resolve
+        the DEFAULT wire/coalesce/cadence environment again. Structural
+        knobs the setup baked in (overlap, a deep super-step,
+        ensemble stacking) persist until re-admission — this clears the
+        trace-time scope."""
+        self.tuned = None
+        self._tuned_env = None
+        self.tuned_stale = False
+        self.tuned_stale_reason = None
 
     # -- the chunk-boundary iteration ---------------------------------------
 
@@ -686,6 +842,7 @@ class ResilientRun:
                 cold=runner_cache_misses() > misses0)
             if verdict is not None:
                 record_event("perf_regression", **verdict)
+                self._mark_tuned_stale("perf_drift")
         if plan is not None:
             from ..telemetry.hooks import observe_reducers
 
@@ -873,7 +1030,10 @@ def run_resilient(step_local, state: dict, nt: int, *,
     realization rolls back alone. Reducer values stream per member
     (labels suffixed ``[m<member>]``); `HealthReport.member` carries the
     member index (E reports per chunk). Elastic restart (`ProcessLoss`)
-    is not supported under ensemble yet.
+    and `ResilientRun.resize` work under ensemble too: the
+    redistribution passes the leading member axis through untouched, so
+    every member re-blocks exactly like a solo field (per-member
+    bit-identity vs the solo elastic run, tests/test_reshard.py).
 
     Output pipeline (the `implicitglobalgrid_tpu/io/` subsystem —
     O(shard) per process, never a gather): ``snapshot_dir`` enables ASYNC

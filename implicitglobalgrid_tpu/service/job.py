@@ -108,6 +108,7 @@ class Job:
         self.slice_s_total = 0.0
         self.wait_s_total = 0.0
         self.cancel_requested = False
+        self.resize_requested = None    # (dims tuple, via); applied at a slice
         self.last_end_t: float | None = None
 
     @property

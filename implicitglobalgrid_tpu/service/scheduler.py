@@ -82,7 +82,7 @@ class MeshScheduler:
     reconstructs the interleaved schedule and
     `service.export_service_trace` renders one Perfetto track per job;
     the directory doubles as the CLI's control channel (`tools jobs
-    cancel|drain` file requests, polled at slice boundaries).
+    cancel|drain|resize` file requests, polled at slice boundaries).
     ``metrics_port`` starts the scheduler-OWNED live endpoint for the
     scheduler's lifetime: per-job labeled gauges, queue depth, and a
     /healthz that judges the SCHEDULER heartbeat (a wedged single job
@@ -229,6 +229,38 @@ class MeshScheduler:
             job.cancel_requested = True
         return job
 
+    def resize(self, name: str, new_dims, *, via: str = "auto") -> Job:
+        """Request an elastic resize of one job: at its NEXT slice
+        boundary the scheduler re-blocks the job's state onto
+        ``new_dims`` (`runtime.ResilientRun.resize` — the on-device
+        HBM-to-HBM collective program, falling back to the
+        checkpoint-based elastic restore), swaps the job's grid epoch,
+        and journals ``job_resized``. The resize consumes that slice;
+        preemption stays chunk-granular and the job's trajectory is
+        bit-identical to its unresized run (the redistribution is
+        exact). This is the SCHEDULER-decision form of the autoscaling
+        primitive: shrink a tenant under load, grow it when the mesh
+        frees up — ``tools jobs resize`` files the same request from the
+        CLI."""
+        self._check_open()
+        job = self.job(name)
+        if job.finished:
+            raise InvalidArgumentError(
+                f"Job {name!r} already finished ({job.state}).")
+        new_dims = tuple(int(d) for d in new_dims)
+        if len(new_dims) != 3 or any(d < 1 for d in new_dims):
+            raise InvalidArgumentError(
+                f"resize: new_dims must be 3 positive ints; got "
+                f"{new_dims}.")
+        if via not in ("auto", "device", "checkpoint"):
+            raise InvalidArgumentError(
+                f"resize: via must be auto|device|checkpoint; got "
+                f"{via!r}.")
+        job.resize_requested = (new_dims, via)
+        self._log("resize_requested", job=name, new_dims=list(new_dims),
+                  via=via)
+        return job
+
     def drain(self) -> None:
         """Stop admitting: cancel every still-QUEUED job, let RUNNING jobs
         finish. (`run()` afterwards completes the running set.)"""
@@ -325,6 +357,8 @@ class MeshScheduler:
             return
         for fname in sorted(os.listdir(ctl)):
             path = os.path.join(ctl, fname)
+            if fname.endswith(".tmp"):
+                continue  # a request still being written (CLI staging)
             if fname == "drain":
                 os.remove(path)
                 self._log("control", request="drain")
@@ -336,6 +370,34 @@ class MeshScheduler:
                 job = self.jobs.get(name)
                 if job is not None and not job.finished:
                     self.cancel(name)
+            elif fname.startswith("resize_"):
+                import json as _json
+
+                name = fname[len("resize_"):]
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        req = _json.load(f)
+                except Exception:
+                    req = None
+                os.remove(path)
+                self._log("control", request="resize", job=name,
+                          payload=req)
+                job = self.jobs.get(name)
+                if job is None or job.finished or not isinstance(req, dict):
+                    # never drop an operator request silently
+                    self._log("resize_rejected", job=name,
+                              error=("malformed control payload"
+                                     if not isinstance(req, dict) else
+                                     "unknown or finished job"))
+                    continue
+                try:
+                    self.resize(name, req.get("new_dims", ()),
+                                via=req.get("via", "auto"))
+                except (InvalidArgumentError, ValueError, TypeError) as e:
+                    # ValueError/TypeError: non-integer new_dims in a
+                    # hand-written control file — an operator typo must
+                    # not take the scheduler (and every tenant) down
+                    self._log("resize_rejected", job=name, error=str(e))
 
     def _admit(self, job: Job) -> None:
         """First slice grant: build the job's grid over the shared device
@@ -411,12 +473,41 @@ class MeshScheduler:
         try:
             if job.state == JobState.QUEUED:
                 self._admit(job)
+            resize_req, job.resize_requested = job.resize_requested, None
             prev = top.swap_global_grid(job.gg)
             try:
                 with use_flight_recorder(job.recorder):
-                    more = job.run.advance()
-                # an elastic restart inside the slice re-inits the grid:
-                # track the NEW grid (and retire the dead epoch's caches)
+                    if resize_req is not None:
+                        # the resize consumes this slice: one epoch-
+                        # swapping re-block at the chunk boundary, then
+                        # the job resumes its schedule next grant. A
+                        # FAILED resize rejects the request and keeps
+                        # the tenant running — one fat-fingered dims
+                        # request must not kill a long-lived job (the
+                        # driver restores its grid on device-path
+                        # failures and the checkpoint fallback is
+                        # non-destructive)
+                        new_dims, via = resize_req
+                        try:
+                            rec = job.run.resize(new_dims, via=via)
+                        except Exception as e:
+                            self._log("resize_rejected", job=job.name,
+                                      new_dims=list(new_dims), via=via,
+                                      error=f"{type(e).__name__}: {e}")
+                            more = not job.run.done
+                        else:
+                            more = not job.run.done
+                            self._log("job_resized", job=job.name,
+                                      new_dims=list(new_dims),
+                                      via=rec.get("via"),
+                                      dur_s=rec.get("seconds"),
+                                      rounds=rec.get("rounds"),
+                                      wire_bytes=rec.get("wire_bytes"),
+                                      step=job.step)
+                    else:
+                        more = job.run.advance()
+                # a resize or elastic restart inside the slice re-inits
+                # the grid: track the NEW one (retire the dead epoch)
                 cur = top._global_grid
                 if cur is not job.gg and cur is not None:
                     old = job.gg
@@ -432,6 +523,14 @@ class MeshScheduler:
             self._finalize(job, JobState.FAILED)
             return
         self._account_slice(job, t_pick, wait_s, chunks0)
+        # re-tune trigger (ROADMAP tuner rung c): a resize or PerfWatch
+        # drift marked the applied TunedConfig stale — the scheduler
+        # reacts at the slice boundary by clearing it (journaled; the
+        # operator re-runs `tools tune` against the new geometry)
+        if job.run is not None and getattr(job.run, "tuned_stale", False):
+            reason = job.run.tuned_stale_reason
+            job.run.clear_tuned()
+            self._log("job_tuned_cleared", job=job.name, reason=reason)
         if not more:
             self._finalize(job, JobState.DONE)
 
@@ -499,6 +598,16 @@ class MeshScheduler:
         scrapeable across job lifetimes."""
         if job.finished:
             return
+        if job.resize_requested is not None:
+            # never drop an operator request silently: a job reaching a
+            # terminal state with a resize still pending journals the
+            # rejection (the control-poll path's rule)
+            new_dims, via = job.resize_requested
+            job.resize_requested = None
+            self._log("resize_rejected", job=job.name,
+                      new_dims=list(new_dims), via=via,
+                      error=f"job reached terminal state {state} before "
+                            "the resize slice")
         if job.run is not None:
             if state == JobState.DONE:
                 from ..utils.timing import sync

@@ -22,7 +22,8 @@ __all__ = ["note_runner_cache", "account_halo_exchange",
            "note_metrics_server_port", "observe_audit",
            "note_scheduler_heartbeat", "note_queue_depth", "job_gauges",
            "observe_job_slice", "clear_scheduler_heartbeat",
-           "note_job_transition", "observe_member_health"]
+           "note_job_transition", "observe_member_health",
+           "observe_reshard"]
 
 # Metric family names (the exported contract; see docs/observability.md).
 RUNNER_CACHE = "igg_runner_cache_total"
@@ -62,6 +63,10 @@ JOB_WAIT_SECONDS = "igg_job_wait_seconds"
 # (the igg_job_* twins are the scheduler's per-tenant scoped mirrors —
 # distinct family names because a ScopedRegistry view adds the job label
 # to the family's labelnames, and one family cannot carry both shapes)
+# on-device elastic resharding (ISSUE 14): resize downtime + wire volume
+RESHARD_BYTES = "igg_reshard_bytes_total"
+RESHARD_SECONDS = "igg_reshard_seconds"
+RESHARD_ROUNDS = "igg_reshard_rounds"
 MEMBER_RMS = "igg_member_rms"
 MEMBER_NONFINITE = "igg_member_nonfinite_cells"
 MEMBER_TRIPS = "igg_member_guard_trips_total"
@@ -96,7 +101,8 @@ def record_health_event(kind: str, n: int = 1) -> None:
     """Bump the resilient-runtime ``igg_health_events_total{kind=...}``
     counter by ``n`` (`runtime.run_resilient`: kinds include ``chunks``,
     ``guard_trips``, ``rollbacks``, ``checkpoints_saved``, ``restores``,
-    ``restore_fallbacks``, ``elastic_restarts``, ``escalations``). Read
+    ``restore_fallbacks``, ``elastic_restarts``, ``escalations``,
+    ``resizes``). Read
     the family via ``igg.metrics_registry()`` or
     ``igg.prometheus_snapshot()`` — the PR-2 `health_counters` dict API
     was retired after two majors of deprecation."""
@@ -104,7 +110,7 @@ def record_health_event(kind: str, n: int = 1) -> None:
         HEALTH_EVENTS,
         "Resilient-runtime events by kind (chunks, guard_trips, rollbacks, "
         "checkpoints_saved, restores, restore_fallbacks, elastic_restarts, "
-        "escalations).", ("kind",)).inc(int(n), kind=str(kind))
+        "escalations, resizes).", ("kind",)).inc(int(n), kind=str(kind))
 
 
 def account_halo_exchange(plan: dict) -> None:
@@ -388,6 +394,39 @@ def observe_member_health(reports, scope=None) -> None:
             nonf.set(float(v), member=m, field=field)
         if not rep.ok:
             trips.inc(1, member=m)
+
+
+def observe_reshard(dur_s: float, *, via: str, new_dims, step=None,
+                    rounds=None, wire_bytes=None, local_bytes=None,
+                    **fields) -> None:
+    """Record one elastic resize (`runtime.ResilientRun.resize`): wall
+    time by path (``via``: ``device`` | ``checkpoint``), the collective
+    program's wire/local byte volume and scheduled round count (device
+    path only — the checkpoint path's volume is its restore's), and the
+    ``resize`` flight event the run report / Perfetto trace render as a
+    span."""
+    reg = metrics_registry()
+    reg.histogram(
+        RESHARD_SECONDS,
+        "Elastic resize wall time (state re-blocked onto new dims), "
+        "by path.", ("via",)).observe(dur_s, via=str(via))
+    bytes_fam = reg.counter(
+        RESHARD_BYTES,
+        "Bytes moved by on-device reshard programs, wire (padded "
+        "all-links ppermute payloads) vs local (same-device copies).",
+        ("kind",))
+    if wire_bytes:
+        bytes_fam.inc(int(wire_bytes), kind="wire")
+    if local_bytes:
+        bytes_fam.inc(int(local_bytes), kind="local")
+    if rounds is not None:
+        reg.gauge(
+            RESHARD_ROUNDS,
+            "Scheduled ppermute slice rounds of the last on-device "
+            "reshard program.").set(int(rounds))
+    record_event("resize", via=str(via), new_dims=list(new_dims),
+                 dur_s=dur_s, step=step, rounds=rounds,
+                 wire_bytes=wire_bytes, local_bytes=local_bytes, **fields)
 
 
 def observe_reducers(step, values: dict, *, ok: bool = True) -> None:

@@ -46,7 +46,8 @@ from ..utils.exceptions import InvalidArgumentError
 
 __all__ = ["MachineProfile", "StepWorkload", "STEP_WORKLOADS",
            "default_machine_profile", "load_machine_profile",
-           "save_machine_profile", "predict_step", "PerfWatch"]
+           "save_machine_profile", "predict_step", "predict_reshard",
+           "PerfWatch"]
 
 _PROFILE_VERSION = 1
 
@@ -468,6 +469,46 @@ def predict_step(model, fields, *, profile: MachineProfile | None = None,
         rec["ensemble_amortization"] = (
             (step_s / E) / solo["step_s"] if solo["step_s"] > 0 else 1.0)
     return rec
+
+
+def predict_reshard(plan, *, profile: MachineProfile | None = None) -> dict:
+    """Static price of one on-device reshard program
+    (`reshard.build_reshard_plan` output) — the `halo_comm_plan`-style
+    accounting of the elastic resize (ISSUE 14): per scheduled round, one
+    collective launch (the latency term) plus the round's padded
+    per-device payload over the link bandwidth; same-device pieces are
+    HBM read+write traffic at the memory-bandwidth coefficient. Link
+    coefficients come from `MachineProfile.axis("rs")` — the flat
+    transfer mesh crosses arbitrary mesh links, so the mean of the
+    calibrated axes is the honest single number.
+
+    Returns ``{"rounds", "wire_bytes", "local_bytes",
+    "peak_payload_bytes", "latency_s", "wire_s", "local_s", "seconds",
+    "profile_source"}``. The DISK path this replaces pays the sharded
+    save + elastic restore instead — `bench_reshard.py` measures both
+    and gates ``reshard_vs_disk_speedup >= 1.0``; this record is the
+    model-side anchor the perfdb trajectory watches."""
+    if profile is None:
+        from ..parallel.topology import grid_is_initialized
+
+        profile = (default_machine_profile() if grid_is_initialized()
+                   else default_machine_profile("cpu"))
+    coeff = profile.axis("rs")
+    per_round = [b for sig in plan.sigs for b in sig.round_payload_bytes]
+    latency_s = len(per_round) * float(coeff.get("latency_s", 0.0))
+    wire_s = sum(b / (float(coeff["GBps"]) * 1e9) for b in per_round)
+    local_s = 2.0 * plan.local_bytes / (profile.membw_GBps * 1e9)
+    return {
+        "rounds": plan.rounds,
+        "wire_bytes": plan.wire_bytes,
+        "local_bytes": plan.local_bytes,
+        "peak_payload_bytes": plan.peak_payload_bytes,
+        "latency_s": latency_s,
+        "wire_s": wire_s,
+        "local_s": local_s,
+        "seconds": latency_s + wire_s + local_s,
+        "profile_source": profile.source,
+    }
 
 
 def _unwrap_field(f):

@@ -50,6 +50,9 @@ _SEQ_FIELDS = {
               "ensemble", "speedup"),
     "perf_regression": ("chunk", "step_begin", "step_end", "per_step_s",
                         "baseline_s", "z", "ratio"),
+    "resize": ("via", "new_dims", "step", "dur_s", "rounds",
+               "wire_bytes"),
+    "tuned_stale": ("reason", "model"),
     "run_end": ("completed", "chunks"),
 }
 
@@ -221,7 +224,7 @@ def run_report(source, *, run_id: str | None = None,
     sequence = []
     chunks, cache = [], {"hits": 0, "misses": 0, "uncached": 0}
     saves, restores, rollbacks = [], [], []
-    trips, escalations, elastic = [], [], []
+    trips, escalations, elastic, resizes = [], [], [], []
     perf_model, perf_regressions = None, []
     audits, audit_failures = [], []
     begin = end = None
@@ -255,6 +258,8 @@ def run_report(source, *, run_id: str | None = None,
             escalations.append(e)
         elif k == "elastic_restart":
             elastic.append(e)
+        elif k == "resize":
+            resizes.append(e)
         elif k == "halo_exchange":
             halo["exchanges"] += 1
             halo["ppermutes"] += e.get("ppermutes", 0)
@@ -326,6 +331,11 @@ def run_report(source, *, run_id: str | None = None,
         "elastic_restarts": [
             {"new_dims": e.get("new_dims"), "to_step": e.get("to_step")}
             for e in elastic],
+        "resizes": [
+            {"via": e.get("via"), "new_dims": e.get("new_dims"),
+             "step": e.get("step"), "dur_s": e.get("dur_s"),
+             "rounds": e.get("rounds"), "wire_bytes": e.get("wire_bytes")}
+            for e in resizes],
         "halo": halo,
         "io": io,
         "audit": _audit_section(audits, audit_failures),

@@ -38,7 +38,7 @@ __all__ = ["export_chrome_trace"]
 # renders them at: process-wide bars.
 _INSTANTS = ("guard_trip", "rollback", "escalation", "elastic_restart",
              "fault_injected", "snapshot_drop", "snapshot_error",
-             "perf_regression")
+             "perf_regression", "tuned_stale")
 
 _TID_DRIVER = 0
 _TID_IO = 1
@@ -190,6 +190,17 @@ def _emit_event(trace: list, e: dict, p: int, us, wire_cum: dict) -> None:
                               "name": "igg_perf_step_seconds",
                               "ts": us(t),
                               "args": {"s": ex / max(1, int(e["n"]))}})
+        elif kind == "resize":
+            # the resize span (ISSUE 14): how long the mesh was re-
+            # blocking instead of stepping — the downtime an operator
+            # weighs against the disk path's
+            dur = float(e.get("dur_s", 0.0) or 0.0)
+            trace.append({"ph": "X", "pid": p, "tid": _TID_DRIVER,
+                          "cat": "resize",
+                          "name": f"resize {e.get('new_dims')} "
+                                  f"[{e.get('via')}]",
+                          "ts": us(t - dur), "dur": dur * 1e6,
+                          "args": _args(e)})
         elif kind in ("checkpoint_save", "checkpoint_restore"):
             dur = float(e.get("dur_s", 0.0) or 0.0)
             trace.append({"ph": "X", "pid": p, "tid": _TID_DRIVER,

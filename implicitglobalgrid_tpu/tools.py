@@ -271,14 +271,28 @@ def _cli(argv=None) -> int:
       dump host-only (``--hlo``, optionally against a ``--contract``
       JSON). EXITS 1 when any error-severity finding survives — the CI
       hook that makes the wire contract gate itself.
-    - ``jobs submit|list|status|cancel|drain`` — the multi-run
+    - ``jobs submit|list|status|cancel|drain|resize`` — the multi-run
       scheduler's operator surface (`service.MeshScheduler`,
       docs/service.md): ``submit QUEUE.json`` runs a JSON-described job
       queue through one persistent-mesh scheduler (exit 1 unless every
       job finishes), ``list``/``status`` inspect a service flight
       directory post-hoc from its journal, ``cancel``/``drain`` file
       control requests a LIVE scheduler consumes at its next
-      chunk-granular slice boundary.
+      chunk-granular slice boundary, and ``resize DIR NAME 1,2,2``
+      files an elastic-resize request: the scheduler re-blocks the
+      job's state onto the new dims at its next slice boundary
+      (HBM-to-HBM when possible, checkpoint-elastic fallback) and
+      journals ``job_resized``.
+    - ``reshard plan|run`` — the on-device elastic resharding subsystem
+      (`implicitglobalgrid_tpu.reshard`, docs/resilience.md): ``plan``
+      prints the (src_dims -> dst_dims) transfer plan host-only
+      (scheduled ppermute rounds, byte accounting, the
+      `predict_reshard` static price); ``run`` executes the collective
+      re-block on a self-initialized grid, audits the compiled program
+      against its plan-derived contract, verifies the moved state
+      bit-identical to the host oracle, and EXITS 1 on a contract
+      violation or mismatch — the CI hook for the reshard wire
+      contract.
     """
     import argparse
     import json
@@ -332,6 +346,19 @@ def _cli(argv=None) -> int:
         "drain", help="file a drain request: cancel queued jobs, finish "
                       "running ones")
     jd.add_argument("flight_dir")
+    jrs = jobs_sub.add_parser(
+        "resize", help="file an elastic-resize request a LIVE scheduler "
+                       "applies at the job's next slice boundary "
+                       "(HBM-to-HBM re-block, checkpoint-elastic "
+                       "fallback; exit 3 unknown job, 4 already "
+                       "finished)")
+    jrs.add_argument("flight_dir")
+    jrs.add_argument("name")
+    jrs.add_argument("dims", help="new decomposition, e.g. 1,2,2")
+    jrs.add_argument("--via", default="auto",
+                     choices=["auto", "device", "checkpoint"],
+                     help="force the on-device or checkpoint path "
+                          "(default: device with fallback)")
     rp = sub.add_parser("report", help="unified run report from a "
                                        "flight-recorder JSONL stream")
     rp.add_argument("jsonl", help="flight-recorder .jsonl file")
@@ -475,6 +502,44 @@ def _cli(argv=None) -> int:
                           "sizes scale by E behind the same ppermute "
                           "pair; recorded in the profile meta)")
     cal.add_argument("--indent", type=int, default=2)
+    rs = sub.add_parser(
+        "reshard", help="on-device elastic resharding: print a transfer "
+                        "plan host-only, or run + contract-audit + "
+                        "verify the collective re-block (exit 1 on "
+                        "violation)")
+    rs_sub = rs.add_subparsers(dest="reshard_cmd", required=True)
+    for prs, what in ((rs_sub.add_parser(
+            "plan", help="derive and print the (src -> dst) transfer "
+                         "plan + its static price (host-only: no grid, "
+                         "no accelerator)"), "plan"),
+            (rs_sub.add_parser(
+                "run", help="execute the re-block on a self-initialized "
+                            "grid, audit the compiled program against "
+                            "the plan contract, verify vs the host "
+                            "oracle (exit 1 on any error finding or "
+                            "mismatch)"), "run")):
+        prs.add_argument("--src-dims", required=True,
+                         help="source decomposition, e.g. 2,2,1")
+        prs.add_argument("--dst-dims", required=True,
+                         help="destination decomposition, e.g. 1,2,2")
+        prs.add_argument("--nx", type=int, default=8,
+                         help="base local block edge on the source dims")
+        prs.add_argument("--fields", type=int, default=2,
+                         help="number of state fields (field 1 is "
+                              "x-staggered, exercising a second "
+                              "signature)")
+        prs.add_argument("--dtype", default="float32")
+        prs.add_argument("--ensemble", type=int, default=None,
+                         help="lead every field with an E-member axis "
+                              "(the batched-state pass-through)")
+        prs.add_argument("--periods", default="0,0,0")
+        prs.add_argument("--overlaps", default="2,2,2")
+        prs.add_argument("--indent", type=int, default=2)
+        prs.add_argument("--json", action="store_true")
+        if what == "run":
+            prs.add_argument("--cpu", action="store_true",
+                             help="run on the 8-device virtual CPU mesh "
+                                  "(the bench scripts' convention)")
     aud = sub.add_parser(
         "audit", help="static analysis of compiled programs: collective "
                       "contract + implicit-grid lints + perfmodel "
@@ -533,6 +598,8 @@ def _cli(argv=None) -> int:
 
     if args.cmd == "audit":
         return _cli_audit(args)
+    if args.cmd == "reshard":
+        return _cli_reshard(args)
     if args.cmd == "jobs":
         return _cli_jobs(args)
     if args.cmd == "tune":
@@ -744,6 +811,124 @@ def _cli_tune(args) -> int:
     return 0
 
 
+def _cli_reshard(args) -> int:
+    """The ``reshard`` subcommand group (docs/resilience.md "On-device
+    resize"). ``plan`` is host-only: derive the transfer plan for a
+    synthetic state and print it with its `predict_reshard` price.
+    ``run`` additionally executes it: self-initialize a grid on the
+    source dims, build the state, re-block it on device
+    (`reshard.reshard_state` with the contract audit on), verify the
+    result bit-identical to the host oracle (`apply_plan_host`), and
+    exit 1 when any error-severity finding — or a single differing
+    byte — survives."""
+    import json
+    import os
+
+    import numpy as np
+
+    from .reshard import apply_plan_host, build_reshard_plan
+    from .telemetry import predict_reshard
+    from .utils.exceptions import InvalidArgumentError
+
+    def _triple(spec, what):
+        out = tuple(int(x) for x in str(spec).split(","))
+        if len(out) != 3:
+            raise InvalidArgumentError(
+                f"tools reshard: {what} must be 3 comma-separated ints; "
+                f"got {spec!r}.")
+        return out
+
+    src_dims = _triple(args.src_dims, "--src-dims")
+    dst_dims = _triple(args.dst_dims, "--dst-dims")
+    per = _triple(args.periods, "--periods")
+    ol = _triple(args.overlaps, "--overlaps")
+    nx = max(int(args.nx), 2 * max(ol))
+    lead = () if args.ensemble is None else (int(args.ensemble),)
+    topo = {"nxyz": np.array([nx] * 3), "dims": np.array(src_dims),
+            "overlaps": np.array(ol), "periods": np.array(per),
+            "halowidths": np.maximum(1, np.array(ol) // 2)}
+    fields = {}
+    for i in range(max(1, int(args.fields))):
+        stag = 1 if i == 1 else 0   # field 1 x-staggered: 2nd signature
+        shape = lead + (src_dims[0] * (nx + stag),
+                        src_dims[1] * nx, src_dims[2] * nx)
+        fields[f"f{i}"] = (shape, str(np.dtype(args.dtype)), len(lead))
+    plan = build_reshard_plan(topo, dst_dims, fields)
+    rec = {"plan": plan.to_json(), "predicted": predict_reshard(plan)}
+
+    if args.reshard_cmd == "plan":
+        print(json.dumps(rec, indent=args.indent, default=str))
+        return 0
+
+    # -- run: execute + audit + verify -------------------------------------
+    if args.cpu:
+        # must precede any jax device use (the bench scripts' idiom)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from .models.common import ensemble_state
+    from .parallel.grid import finalize_global_grid, init_global_grid
+    from .parallel.topology import grid_is_initialized
+    from .reshard import fields_of_state, reshard_state
+
+    if plan.n_flat > len(jax.devices()):
+        raise InvalidArgumentError(
+            f"tools reshard run: the transfer mesh needs {plan.n_flat} "
+            f"device(s), {len(jax.devices())} available.")
+    if grid_is_initialized():
+        raise InvalidArgumentError(
+            "tools reshard run re-initializes the global grid; run it "
+            "in a fresh process.")
+    init_global_grid(nx, nx, nx, dimx=src_dims[0], dimy=src_dims[1],
+                     dimz=src_dims[2], periodx=per[0], periody=per[1],
+                     periodz=per[2], overlaps=ol, quiet=True)
+    try:
+        from .ops.alloc import device_put_g
+
+        rng = np.random.default_rng(14)
+        state = {}
+        for name, (shape, dtype, nlead) in fields.items():
+            host = rng.normal(size=shape[nlead:]).astype(dtype)
+            arr = device_put_g(host)
+            if nlead:
+                arr = ensemble_state(arr, shape[0], perturb=0.01)
+            state[name] = arr
+        host_state = {k: np.asarray(v) for k, v in state.items()}
+        plan = build_reshard_plan(topo, dst_dims, fields_of_state(state))
+        expect = apply_plan_host(plan, host_state)
+        new_state, info = reshard_state(state, dst_dims, audit=True)
+        report = info.pop("audit_report")
+        mismatch = [k for k in state
+                    if not np.array_equal(np.asarray(new_state[k]),
+                                          expect[k])]
+        ok = bool(report is not None and report.ok and not mismatch)
+        rec.update(
+            audit=None if report is None else report.to_json(),
+            audit_error=info.get("audit_error"),
+            verified=not mismatch, mismatched_fields=mismatch, ok=ok)
+    finally:
+        if grid_is_initialized():
+            finalize_global_grid()
+    if args.json:
+        print(json.dumps(rec, indent=args.indent, default=str))
+    else:
+        a = rec["audit"]
+        print(f"reshard {src_dims} -> {dst_dims}: "
+              f"{'OK' if ok else 'FAIL'} rounds={plan.rounds} "
+              f"wire_bytes={plan.wire_bytes} "
+              f"audit={'ok' if a and a['ok'] else 'FAIL'} "
+              f"verify={'bit-identical' if not mismatch else mismatch}")
+        if a:
+            for f in a["findings"]:
+                print(f"  [{f['severity']}] {f['rule']}: {f['message']}")
+    return 0 if ok else 1
+
+
 def _cli_jobs(args) -> int:
     """The ``jobs`` subcommand group: the multi-run scheduler's operator
     surface (`docs/service.md`).
@@ -880,6 +1065,37 @@ def _cli_jobs(args) -> int:
         with open(path, "w", encoding="utf-8"):
             pass
         print(json.dumps({"requested": "cancel", "job": args.name,
+                          "control": path}))
+        return 0
+    if args.jobs_cmd == "resize":
+        jobs = service_report(args.flight_dir,
+                              include_jobs=False)["jobs"]
+        job = jobs.get(args.name)
+        if job is None:
+            print(json.dumps({"error": f"no job named {args.name!r}",
+                              "have": list(jobs)}))
+            return 3
+        if job["state"] not in ("queued", "running"):
+            print(json.dumps({"error": f"job {args.name!r} already "
+                                       f"{job['state']}"}))
+            return 4
+        try:
+            dims = [int(x) for x in str(args.dims).split(",")]
+        except ValueError:
+            dims = []
+        if len(dims) != 3 or any(d < 1 for d in dims):
+            raise InvalidArgumentError(
+                f"tools jobs resize: dims must be 3 positive "
+                f"comma-separated ints; got {args.dims!r}.")
+        os.makedirs(ctl, exist_ok=True)
+        path = os.path.join(ctl, f"resize_{args.name}")
+        # atomic: the scheduler polls this directory at slice boundaries
+        # and must never read (and consume) a half-written request
+        with open(path + ".tmp", "w", encoding="utf-8") as f:
+            json.dump({"new_dims": dims, "via": args.via}, f)
+        os.replace(path + ".tmp", path)
+        print(json.dumps({"requested": "resize", "job": args.name,
+                          "new_dims": dims, "via": args.via,
                           "control": path}))
         return 0
     # drain

@@ -93,7 +93,7 @@ from .blockio import (
 __all__ = ["save_checkpoint", "restore_checkpoint", "load_checkpoint",
            "save_checkpoint_sharded", "restore_checkpoint_sharded",
            "restore_checkpoint_elastic", "saved_topology",
-           "elastic_local_size"]
+           "elastic_local_size", "AxisRedistribution"]
 
 # The container format (shard_key block layout, meta/arr key prefixes,
 # fsync'ed writes + sha256 sidecars, staged-directory atomic commit) is
@@ -495,7 +495,7 @@ def elastic_local_size(topo: dict, new_dims) -> tuple:
     return tuple(out)
 
 
-class _AxisRedistribution:
+class AxisRedistribution:
     """Per-dimension owner/coverage maps of the elastic re-blocking.
 
     Physical index space: the `gather_interior` convention — a periodic
@@ -541,6 +541,21 @@ class _AxisRedistribution:
         return c * self._s_n + i
 
 
+class _IdentityAxis:
+    """Degenerate axis map for LEADING MEMBER axes (the ensemble axis):
+    replicated, never decomposed — every cell owned by 'block' 0 at its
+    own index, so the redistribution passes the axis through untouched
+    (ROADMAP ensemble rung c: elastic restart for batched runs)."""
+
+    def __init__(self, n: int):
+        self.ng = int(n)
+        self.c_of = np.zeros(self.ng, dtype=np.int64)
+        self.i_of = np.arange(self.ng)
+
+    def new_phys(self, c: int) -> np.ndarray:
+        return np.arange(self.ng)
+
+
 def restore_checkpoint_elastic(dirpath):
     """Restore a `save_checkpoint_sharded` directory onto a grid whose
     ``dims`` DIFFER from the saved decomposition — the elastic-restart
@@ -554,12 +569,15 @@ def restore_checkpoint_elastic(dirpath):
     Requires equal ``overlaps``/``periods``/``halowidths`` and the same
     implicit global size (`elastic_local_size` computes the local block
     size to re-init with); a live grid equal to the saved one delegates to
-    the plain block-keyed restore. Returns ``(state, step)``."""
+    the plain block-keyed restore. Member-stacked (ensemble) state
+    re-blocks too: the recorded leading member axes are passed through
+    untouched (each member's cells redistribute exactly like a solo
+    field's — per-member bit-identity asserted in tests), so
+    `ProcessLoss` recovery and `ResilientRun.resize` work under
+    ``ensemble=E``. Returns ``(state, step)``."""
     import itertools
 
     import jax
-
-    from ..ops.alloc import sharding_of
 
     check_initialized()
     t0 = time.monotonic()
@@ -574,13 +592,6 @@ def restore_checkpoint_elastic(dirpath):
             np.array_equal(nxyz_o, np.asarray(gg.nxyz)):
         return restore_checkpoint_sharded(
             dirpath, _preloaded=(meta, files, checksums, verified))
-    if any(int(meta.get(f"lead__{n}", 0)) for n in names):
-        raise IncoherentArgumentError(
-            "Elastic restore of member-stacked (ensemble) state onto a "
-            "DIFFERENT decomposition is not supported: the "
-            "redistribution reasons over the 3 spatial axes and would "
-            "remap the member axis. Restore onto the saved dims "
-            "instead.")
     for field in ("overlaps", "periods", "halowidths"):
         if not np.array_equal(np.asarray(meta[field]),
                               np.asarray(getattr(gg, field))):
@@ -606,24 +617,36 @@ def restore_checkpoint_elastic(dirpath):
         shape_o = tuple(int(s) for s in meta[f"shape__{name}"])
         dtype = np.dtype(str(meta[f"dtype__{name}"]))
         nd = len(shape_o)
+        # leading member axes (ensemble state): replicated, re-blocking
+        # skips them — the per-axis maps below reason over the SPATIAL
+        # axes only and every member's cells travel with its slice
+        lead = int(meta.get(f"lead__{name}", 0))
         loc_o, loc_n, axes = [], [], []
         for d in range(nd):
-            dd_o = int(dims_o[d])
+            if d < lead:
+                axes.append(_IdentityAxis(shape_o[d]))
+                loc_o.append(shape_o[d])
+                loc_n.append(shape_o[d])
+                continue
+            sd = d - lead
+            dd_o = int(dims_o[sd])
             if shape_o[d] % dd_o:
                 raise IncoherentArgumentError(
                     f"Saved stacked size {shape_o[d]} of `{name}` along "
-                    f"dimension {d} is not divisible by the saved "
-                    f"dims[{d}]={dd_o}.")
+                    f"dimension {sd} is not divisible by the saved "
+                    f"dims[{sd}]={dd_o}.")
             lo = shape_o[d] // dd_o
-            stag = lo - int(nxyz_o[d])      # staggered fields carry their
-            ln = int(gg.nxyz[d]) + stag     # extra cells to the new blocks
-            axes.append(_AxisRedistribution(
-                lo, ln, dd_o, int(gg.dims[d]),
-                int(ol[d]) + stag, bool(per[d])))
+            stag = lo - int(nxyz_o[sd])     # staggered fields carry their
+            ln = int(gg.nxyz[sd]) + stag    # extra cells to the new blocks
+            axes.append(AxisRedistribution(
+                lo, ln, dd_o, int(gg.dims[sd]),
+                int(ol[sd]) + stag, bool(per[sd])))
             loc_o.append(lo)
             loc_n.append(ln)
-        shape_n = tuple(int(gg.dims[d]) * loc_n[d] for d in range(nd))
-        sharding = sharding_of(nd)
+        shape_n = tuple(loc_n[d] if d < lead
+                        else int(gg.dims[d - lead]) * loc_n[d]
+                        for d in range(nd))
+        sharding = _restore_sharding(meta, name, shape_n)
         needed = sharding.addressable_devices_indices_map(shape_n)
         by_start: dict = {}
         for dev, idx in needed.items():

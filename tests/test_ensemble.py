@@ -73,10 +73,15 @@ def test_ensemble_state_layout_and_validation():
         run_diffusion(T, Cp, p, 2, ensemble=4)
     with pytest.raises(InvalidArgumentError, match="ensemble_state"):
         igg.run_resilient(lambda s: s, {"T": T}, 2, ensemble=4)
-    with pytest.raises(InvalidArgumentError, match="not supported"):
-        igg.run_resilient(
-            lambda s: s, {"T": ET}, 4, ensemble=E,
-            faults=[igg.ProcessLoss(step=2, new_dims=(1, 2, 2))])
+    # ProcessLoss under ensemble is ACCEPTED since ISSUE 14: the elastic
+    # redistribution passes the member axis through untouched (the
+    # end-to-end restart rides tests/test_reshard.py) — validation-level,
+    # the machine constructs cleanly with the fault queued
+    from implicitglobalgrid_tpu.runtime.driver import ResilientRun
+
+    run = ResilientRun(lambda s: s, {"T": ET}, 4, igg.RunSpec(
+        ensemble=E, faults=(igg.ProcessLoss(step=2, new_dims=(1, 2, 2)),)))
+    run.close()
 
 
 # ---------------------------------------------------------------------------
@@ -171,14 +176,11 @@ def test_ensemble_2d_checkpoint_roundtrip(tmp_path):
     the saved set. The save now records each array's leading replicated
     (member) axes and restore rebuilds the TRUE sharding — round-trip
     bit-exact through both the plain and the elastic (same-dims
-    delegation) paths; elastic onto DIFFERENT dims rejects member-stacked
-    state loudly."""
+    delegation) paths; elastic onto DIFFERENT dims re-blocks the batched
+    state too (ISSUE 14: the member axis passes through untouched)."""
     from jax.sharding import PartitionSpec as P
 
     from implicitglobalgrid_tpu.models import ensemble_state
-    from implicitglobalgrid_tpu.utils.exceptions import (
-        IncoherentArgumentError,
-    )
 
     igg.init_global_grid(6, 6, 1, dimx=4, dimy=2, dimz=1, quiet=True)
     T = igg.ones_g((6, 6), np.float32)
@@ -192,11 +194,19 @@ def test_ensemble_2d_checkpoint_roundtrip(tmp_path):
     assert st["T"].sharding.spec == P(None, "gx", "gy")
     st2, _ = igg.restore_checkpoint_elastic(d)  # same-dims delegation
     assert np.array_equal(np.asarray(st2["T"]), np.asarray(ET))
-    # a DIFFERENT decomposition must reject member-stacked state loudly
+    # a DIFFERENT decomposition of the same implicit global grid
+    # (18, 10): the batched state re-blocks with the member axis passed
+    # through — T is a per-member constant ramp, so every restored
+    # member must be exactly its constant
     igg.finalize_global_grid()
-    igg.init_global_grid(12, 3, 1, dimx=2, dimy=4, dimz=1, quiet=True)
-    with pytest.raises(IncoherentArgumentError, match="member-stacked"):
-        igg.restore_checkpoint_elastic(d)
+    igg.init_global_grid(10, 4, 1, dimx=2, dimy=4, dimz=1, quiet=True)
+    st3, _ = igg.restore_checkpoint_elastic(d)
+    got = np.asarray(st3["T"])
+    assert got.shape == (E, 20, 16)
+    assert st3["T"].sharding.spec == P(None, "gx", "gy")
+    for m in range(E):
+        assert np.array_equal(
+            got[m], np.full((20, 16), np.float32(1 + 0.5 * m)))
 
 
 # ---------------------------------------------------------------------------

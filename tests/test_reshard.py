@@ -1,0 +1,608 @@
+"""On-device elastic resharding (ISSUE 14): the redistribution plan, the
+compiled collective program, `ResilientRun.resize`, the scheduler
+decision, and the ensemble pass-through.
+
+The acceptance bar everywhere is BIT-IDENTITY: the plan's host oracle
+against an independently-built global field, the device program against
+the oracle, the on-device resize against the checkpoint-based elastic
+path (the verified fallback) AND against the unresized run — the
+redistribution moves raw bytes, so a single differing byte anywhere is a
+failure, never a tolerance."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.reshard import (
+    apply_plan_host, build_reshard_plan, fields_of_state, live_topology,
+    reshard_contract, reshard_state,
+)
+from implicitglobalgrid_tpu.utils.checkpoint import AxisRedistribution
+from implicitglobalgrid_tpu.utils.exceptions import (
+    IncoherentArgumentError, InvalidArgumentError,
+)
+
+from conftest import (
+    health_counters_from_registry as _health_counters,
+    reset_health_counters_in_registry as _reset_health_counters,
+)
+
+_FIXTURE = os.path.join(os.path.dirname(__file__), "data", "hlo",
+                        "reshard_2x2x1_to_1x2x2.hlo.txt")
+
+
+def _topo(nxyz=(6, 6, 6), dims=(2, 2, 1), ol=(2, 2, 2), per=(0, 0, 0)):
+    return {"nxyz": np.array(nxyz), "dims": np.array(dims),
+            "overlaps": np.array(ol), "periods": np.array(per),
+            "halowidths": np.maximum(1, np.array(ol) // 2)}
+
+
+def _blocks_from_global(G, dims, loc, ol, per):
+    """Exchange-fresh stacked layout of global field ``G``: block c's
+    cell i holds G[phys(c, i)] — the independent reference every
+    re-block must reproduce exactly."""
+    import itertools
+
+    nd = len(loc)
+    axes = [AxisRedistribution(loc[d], loc[d], dims[d], dims[d], ol[d],
+                               bool(per[d])) for d in range(nd)]
+    out = np.zeros([dims[d] * loc[d] for d in range(nd)], dtype=G.dtype)
+    for c in itertools.product(*[range(dims[d]) for d in range(nd)]):
+        idx = np.ix_(*[axes[d].new_phys(c[d]) for d in range(nd)])
+        sel = tuple(slice(c[d] * loc[d], (c[d] + 1) * loc[d])
+                    for d in range(nd))
+        out[sel] = G[idx]
+    return out
+
+
+def _ng(dims, loc, ol, per):
+    return tuple(dims[d] * (loc[d] - ol[d]) + (0 if per[d] else ol[d])
+                 for d in range(len(loc)))
+
+
+# ---------------------------------------------------------------------------
+# the plan: host-only coverage/partition proofs (no grid, no devices)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("src,dst,per", [
+    ((2, 2, 1), (1, 2, 2), (0, 0, 0)),   # rotate (the re-balance move)
+    ((2, 2, 1), (2, 1, 1), (0, 0, 0)),   # shrink (lost-capacity move)
+    ((1, 2, 1), (2, 2, 2), (1, 0, 1)),   # grow, periodic axes
+])
+def test_plan_host_oracle_matches_global_field(src, dst, per):
+    """Every destination cell ends holding exactly the global-field value
+    its physical coordinate names — for plain, staggered, and
+    member-stacked fields, across grow/shrink/periodic re-blockings."""
+    nx, ol = (6, 6, 6), (2, 2, 2)
+    topo = _topo(nx, src, ol, per)
+    rng = np.random.default_rng(3)
+
+    loc_T = (6, 6, 6)
+    loc_P = (7, 6, 6)                    # x-staggered: ol_f = 3 on x
+    ol_P = (3, 2, 2)
+    GT = rng.normal(size=_ng(src, loc_T, ol, per))
+    GP = rng.normal(size=_ng(src, loc_P, ol_P, per))
+    T = _blocks_from_global(GT, src, loc_T, ol, per)
+    P = _blocks_from_global(GP, src, loc_P, ol_P, per)
+    E = np.stack([T, 2.0 * T, -T])       # member axis passes through
+
+    fields = {"T": (T.shape, "float64", 0), "P": (P.shape, "float64", 0),
+              "E": (E.shape, "float64", 1)}
+    plan = build_reshard_plan(topo, dst, fields)
+    out = apply_plan_host(plan, {"T": T, "P": P, "E": E})
+
+    from implicitglobalgrid_tpu.utils.checkpoint import elastic_local_size
+
+    nxyz_dst = elastic_local_size(topo, dst)
+    loc_Td = tuple(nxyz_dst)
+    loc_Pd = (nxyz_dst[0] + 1, nxyz_dst[1], nxyz_dst[2])
+    T_ref = _blocks_from_global(GT, dst, loc_Td, ol, per)
+    P_ref = _blocks_from_global(GP, dst, loc_Pd, ol_P, per)
+    assert np.array_equal(out["T"], T_ref)
+    assert np.array_equal(out["P"], P_ref)
+    assert np.array_equal(out["E"],
+                          np.stack([T_ref, 2.0 * T_ref, -T_ref]))
+
+
+def test_plan_rounds_are_partial_permutations():
+    """Each scheduled round is one legal ppermute: unique sources, unique
+    destinations, no self-pairs (those are local rounds), slots inside
+    the flat mesh; byte accounting consistent with the round shapes."""
+    plan = build_reshard_plan(
+        _topo(), (1, 2, 2),
+        {"T": ((12, 12, 6), "float32", 0), "P": ((14, 12, 6), "float32", 0)})
+    assert plan.n_flat == 4 and plan.rounds > 0
+    for sig in plan.sigs:
+        for r in sig.rounds:
+            srcs = [a for a, _ in r.pairs]
+            dsts = [b for _, b in r.pairs]
+            assert len(set(srcs)) == len(srcs)
+            assert len(set(dsts)) == len(dsts)
+            assert all(a != b for a, b in r.pairs)
+            assert all(0 <= s < plan.n_flat for s in srcs + dsts)
+            for p in r.pieces:
+                assert all(p.size[d] <= r.pad[d]
+                           for d in range(len(r.pad)))
+        assert all(p.src_rank == p.dst_rank for p in sig.local)
+    expected = sum(
+        int(np.prod(r.pad)) * len(r.pairs)
+        * len(sig.names) * np.dtype(sig.dtype).itemsize
+        for sig in plan.sigs for r in sig.rounds)
+    assert plan.wire_bytes == expected
+    assert plan.payload_bytes <= plan.wire_bytes
+
+
+def test_plan_validation_errors():
+    topo = _topo()
+    fields = {"T": ((12, 12, 6), "float32", 0)}
+    with pytest.raises(InvalidArgumentError, match="nothing to re-block"):
+        build_reshard_plan(topo, (2, 2, 1), fields)
+    with pytest.raises(IncoherentArgumentError, match="divide"):
+        build_reshard_plan(topo, (3, 1, 1), fields)  # interior 10-2=8, not /3
+    with pytest.raises(IncoherentArgumentError, match="not divisible"):
+        build_reshard_plan(topo, (1, 2, 2),
+                           {"T": ((13, 12, 6), "float32", 0)})
+    with pytest.raises(IncoherentArgumentError, match="inconsistent"):
+        # local blocks of 3 over dims 2 on an nxyz=6 grid: stag = -3
+        build_reshard_plan(topo, (1, 2, 2),
+                           {"T": ((6, 6, 6), "float32", 0)})
+    with pytest.raises(InvalidArgumentError, match="positive"):
+        build_reshard_plan(topo, (0, 2, 2), fields)
+
+
+def test_predict_reshard_static_record():
+    plan = build_reshard_plan(
+        _topo(), (1, 2, 2), {"T": ((12, 12, 6), "float32", 0)})
+    rec = igg.predict_reshard(plan)
+    assert rec["rounds"] == plan.rounds
+    assert rec["wire_bytes"] == plan.wire_bytes
+    assert rec["seconds"] > 0
+    assert rec["seconds"] == pytest.approx(
+        rec["latency_s"] + rec["wire_s"] + rec["local_s"])
+    assert rec["profile_source"] in ("default", "calibrated")
+
+
+# ---------------------------------------------------------------------------
+# the contract + golden fixture (host-only)
+# ---------------------------------------------------------------------------
+
+def _fixture_plan():
+    return build_reshard_plan(
+        _topo(), (1, 2, 2),
+        {"T": ((12, 12, 6), "float32", 0), "P": ((14, 12, 6), "float32", 0)})
+
+
+def test_golden_fixture_contract_byte_exact():
+    """The committed optimized-HLO dump of the canonical transfer program
+    honors the HOST-DERIVED contract to the byte: one collective-permute
+    per scheduled round, routes matching the plan's pair sets verbatim,
+    padded payload bytes exact, zero reductions/gathers."""
+    from implicitglobalgrid_tpu.analysis import audit_program, parse_program
+
+    plan = _fixture_plan()
+    with open(_FIXTURE, encoding="utf-8") as f:
+        text = f.read()
+    rep = audit_program(text, contract=reshard_contract(plan))
+    assert rep.ok, [f.message for f in rep.findings]
+    ir = parse_program(text)
+    assert len(ir.permutes) == plan.rounds
+    assert sum(ir.wire_bytes_of(p) for p in ir.permutes) == plan.wire_bytes
+    assert not ir.all_reduces and not ir.all_gathers and not ir.all_to_alls
+
+
+def test_golden_fixture_detects_drift():
+    """The gate has teeth: a contract for a DIFFERENT re-blocking (other
+    destination dims — different rounds/routes/bytes) must fail against
+    the committed program."""
+    from implicitglobalgrid_tpu.analysis import audit_program
+
+    other = build_reshard_plan(
+        _topo(), (2, 1, 1),
+        {"T": ((12, 12, 6), "float32", 0), "P": ((14, 12, 6), "float32", 0)})
+    with open(_FIXTURE, encoding="utf-8") as f:
+        text = f.read()
+    rep = audit_program(text, contract=reshard_contract(other))
+    assert not rep.ok
+    assert any(f.rule in ("permute-route", "permute-count", "wire-bytes")
+               for f in rep.findings)
+
+
+def test_reshard_cli_plan_host_only(capsys):
+    from implicitglobalgrid_tpu.tools import _cli
+
+    rc = _cli(["reshard", "plan", "--src-dims", "2,2,1",
+               "--dst-dims", "1,2,2", "--nx", "6", "--indent", "0"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["plan"]["rounds"] > 0
+    assert rec["predicted"]["seconds"] > 0
+    assert rec["plan"]["src_dims"] == [2, 2, 1]
+
+
+# ---------------------------------------------------------------------------
+# the driver: resize fast path vs the checkpoint oracle (tier-1 rep)
+# ---------------------------------------------------------------------------
+
+def _diffusion_step():
+    from implicitglobalgrid_tpu.models import (
+        diffusion_step_local, init_diffusion3d,
+    )
+
+    T, Cp, p = init_diffusion3d(dtype=np.float64)
+
+    def step(s):
+        return {"T": diffusion_step_local(s["T"], s["Cp"], p, "xla"),
+                "Cp": s["Cp"]}
+
+    return step, {"T": T, "Cp": Cp}
+
+
+def _run_resized(tmp_path, tag, via, nt=12, resize_at=6, tuned=None,
+                 audit=False):
+    from implicitglobalgrid_tpu.runtime.driver import ResilientRun
+
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=1, quiet=True)
+    step, state = _diffusion_step()
+    run = ResilientRun(step, state, nt, igg.RunSpec(
+        nt_chunk=3, key=("reshard_t", tag),
+        checkpoint_dir=str(tmp_path / f"ck_{tag}"), tuned=tuned,
+        audit=audit))
+    recs = []
+    try:
+        while run.advance():
+            if via is not None and run.step == resize_at:
+                recs.append(run.resize((1, 2, 2), via=via))
+                via = None
+    finally:
+        run.close()
+    out = np.asarray(igg.gather_interior(run.state["T"]))
+    stale = run.tuned_stale_reason
+    igg.finalize_global_grid()
+    return out, recs, stale
+
+
+@pytest.mark.faults
+def test_resize_device_vs_checkpoint_vs_unresized(tmp_path):
+    """THE acceptance loop: a mid-run dims change through the on-device
+    collective program ends bit-identical to the checkpoint-based
+    elastic path AND to the never-resized run — with the reshard program
+    contract-audited in-flight, the resize span + metrics recorded, and
+    an applied TunedConfig marked stale."""
+    from implicitglobalgrid_tpu.telemetry import TunedConfig
+
+    ref, _, _ = _run_resized(tmp_path, "ref", via=None)
+
+    _reset_health_counters()
+    igg.start_flight_recorder(str(tmp_path / "fr.jsonl"))
+    try:
+        dev, recs, stale = _run_resized(
+            tmp_path, "dev", via="device", audit=True,
+            tuned=TunedConfig(model="diffusion3d"))
+    finally:
+        igg.stop_flight_recorder()
+    assert _health_counters()["resizes"] == 1
+    ckp, _, _ = _run_resized(tmp_path, "ckp", via="checkpoint")
+
+    assert np.array_equal(dev, ckp)
+    assert np.array_equal(dev, ref)
+    assert recs[0]["via"] == "device" and recs[0]["rounds"] > 0
+    assert stale == "resize"   # re-tune trigger satellite
+
+    evs = igg.read_flight_events(str(tmp_path / "fr.jsonl"))
+    resize = [e for e in evs if e.get("kind") == "resize"]
+    assert len(resize) == 1 and resize[0]["via"] == "device"
+    assert resize[0]["wire_bytes"] > 0 and resize[0]["dur_s"] > 0
+    stale_evs = [e for e in evs if e.get("kind") == "tuned_stale"]
+    assert len(stale_evs) == 1 and stale_evs[0]["reason"] == "resize"
+    audits = [e for e in evs if e.get("kind") == "audit"
+              and e.get("program") == "reshard"]
+    assert len(audits) == 1 and audits[0]["ok"]
+    fam = igg.metrics_registry().get("igg_reshard_rounds")
+    assert fam is not None and fam.samples()[0][1] == recs[0]["rounds"]
+    fam = igg.metrics_registry().get("igg_reshard_bytes_total")
+    kinds = {labels["kind"] for labels, _ in fam.samples()}
+    assert "wire" in kinds
+
+
+def test_resize_validation():
+    from implicitglobalgrid_tpu.runtime.driver import ResilientRun
+
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=1, quiet=True)
+    step, state = _diffusion_step()
+    run = ResilientRun(step, state, 6, igg.RunSpec(nt_chunk=3,
+                                                   key="reshard_val"))
+    try:
+        with pytest.raises(InvalidArgumentError, match="via"):
+            run.resize((1, 2, 2), via="nope")
+        rec = run.resize((2, 2, 1))          # same dims: recorded no-op
+        assert rec["via"] == "noop"
+        # dims that cannot decompose the grid, or that exceed the device
+        # pool, are ARGUMENT errors — rejected before ANY path touches
+        # the grid (the elastic fallback tears the grid down before its
+        # init would fail, so letting them through would kill the run)
+        with pytest.raises(IncoherentArgumentError, match="divide"):
+            run.resize((3, 1, 1))
+        with pytest.raises(InvalidArgumentError, match="device"):
+            run.resize((8, 2, 1))   # divides (interior 8,8,4) but > pool
+        assert igg.grid_is_initialized()   # pre-checks never touch it
+        from implicitglobalgrid_tpu.utils.exceptions import ResilienceError
+
+        with pytest.raises(ResilienceError, match="no checkpoint_dir"):
+            run.resize((1, 2, 2), via="checkpoint")
+    finally:
+        run.close()
+
+
+# ---------------------------------------------------------------------------
+# ensemble: the member axis passes through (ROADMAP ensemble rung c)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.ensemble
+def test_ensemble_elastic_restore_per_member_bit_identity(tmp_path):
+    """The satellite's literal check: a member-stacked checkpoint
+    restores onto DIFFERENT dims with every member bit-identical to the
+    solo elastic restore of that member's own field."""
+    from implicitglobalgrid_tpu.models import ensemble_state
+    from implicitglobalgrid_tpu.utils.checkpoint import (
+        elastic_local_size, saved_topology,
+    )
+
+    E = 3
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=1, quiet=True)
+    x, y, z = igg.coords_g(0.5, 0.5, 0.5, igg.zeros_g())
+    T = igg.device_put_g(np.asarray(x + 10 * y + 100 * z))
+    Te = ensemble_state(T, E, perturb=0.01)
+    members = [np.asarray(Te[m]) for m in range(E)]
+    igg.save_checkpoint_sharded(str(tmp_path / "ens"), {"T": Te}, step=7)
+    for m in range(E):
+        igg.save_checkpoint_sharded(str(tmp_path / f"solo{m}"),
+                                    {"T": igg.device_put_g(members[m])})
+    igg.finalize_global_grid()
+
+    topo = saved_topology(str(tmp_path / "ens"))
+    nx = elastic_local_size(topo, (1, 2, 2))
+    igg.init_global_grid(*nx, dimx=1, dimy=2, dimz=2, quiet=True)
+    st, step = igg.restore_checkpoint_elastic(str(tmp_path / "ens"))
+    assert step == 7
+    assert tuple(st["T"].sharding.spec) == (None, "gx", "gy", "gz")
+    got = np.asarray(st["T"])
+    for m in range(E):
+        solo, _ = igg.restore_checkpoint_elastic(str(tmp_path / f"solo{m}"))
+        assert np.array_equal(got[m], np.asarray(solo["T"])), f"member {m}"
+
+
+@pytest.mark.faults
+@pytest.mark.ensemble
+def test_ensemble_process_loss_elastic_restart(tmp_path):
+    """ProcessLoss under ensemble=E (previously rejected): the batch
+    restarts elastically on the new dims and ends bit-identical to the
+    unfaulted ensemble run."""
+    from implicitglobalgrid_tpu.models import ensemble_state
+
+    E = 2
+
+    def setup():
+        step, state = _diffusion_step()
+        return step, {"T": ensemble_state(state["T"], E, perturb=0.01),
+                      "Cp": ensemble_state(state["Cp"], E)}
+
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=1, quiet=True)
+    step, est = setup()
+    ref, _ = igg.run_resilient(step, est, 9, nt_chunk=3, key="ens_pl",
+                               ensemble=E,
+                               checkpoint_dir=str(tmp_path / "ref"))
+    ref_m = [np.asarray(igg.gather_interior(ref["T"][m]))
+             for m in range(E)]
+    igg.finalize_global_grid()
+
+    _reset_health_counters()
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=1, quiet=True)
+    step, est = setup()
+    out, _ = igg.run_resilient(
+        step, est, 9, nt_chunk=3, key="ens_pl", ensemble=E,
+        checkpoint_dir=str(tmp_path / "pl"),
+        faults=[igg.ProcessLoss(step=4, new_dims=(1, 2, 2))])
+    assert tuple(int(d) for d in igg.global_grid().dims) == (1, 2, 2)
+    assert _health_counters()["elastic_restarts"] == 1
+    for m in range(E):
+        got = np.asarray(igg.gather_interior(out["T"][m]))
+        assert np.array_equal(got, ref_m[m]), f"member {m}"
+
+
+# ---------------------------------------------------------------------------
+# the scheduler decision (+ control file, + tuned clearing)
+# ---------------------------------------------------------------------------
+
+def test_scheduler_resize_at_slice_boundary(tmp_path, capsys):
+    """A `tools jobs resize` request re-blocks one tenant at its next
+    slice boundary (journaled ``job_resized``, on-device path) while the
+    OTHER tenant stays bit-identical to its solo run; the resized job's
+    final state equals its solo state re-blocked (the exact-transfer
+    identity), and the job's stale TunedConfig is cleared at the
+    boundary (``job_tuned_cleared``)."""
+    from implicitglobalgrid_tpu.service import (
+        JobSpec, MeshScheduler, builtin_setup,
+    )
+    from implicitglobalgrid_tpu.telemetry import TunedConfig
+    from implicitglobalgrid_tpu.tools import _cli
+
+    grid = dict(nx=6, ny=6, nz=6, dimx=2, dimy=2, dimz=1)
+
+    def solo(name):
+        sched = MeshScheduler(policy="fifo")
+        try:
+            sched.submit(JobSpec(
+                name=name, setup=builtin_setup("diffusion3d", "float64"),
+                nt=12, grid=dict(grid),
+                run=igg.RunSpec(nt_chunk=3, key=("rs_svc", name))))
+            sched.run()
+            return np.asarray(sched.results()[name]["T"])
+        finally:
+            sched.close()
+
+    a_solo, b_solo = solo("a"), solo("b")
+
+    fd = str(tmp_path / "svc")
+    sched = MeshScheduler(policy="round_robin", flight_dir=fd)
+    try:
+        sched.submit(JobSpec(
+            name="a", setup=builtin_setup("diffusion3d", "float64"),
+            nt=12, grid=dict(grid),
+            run=igg.RunSpec(nt_chunk=3, key=("rs_svc", "a"),
+                            checkpoint_dir=str(tmp_path / "ck_a"),
+                            tuned=TunedConfig(model="diffusion3d"))))
+        sched.submit(JobSpec(
+            name="b", setup=builtin_setup("diffusion3d", "float64"),
+            nt=12, grid=dict(grid),
+            run=igg.RunSpec(nt_chunk=3, key=("rs_svc", "b"))))
+        for _ in range(4):
+            sched.step()
+        # the CLI files the control request; the live scheduler consumes
+        # it at the next slice boundary
+        assert _cli(["jobs", "resize", fd, "a", "1,2,2"]) == 0
+        req = json.loads(capsys.readouterr().out)
+        assert req["requested"] == "resize" and req["new_dims"] == [1, 2, 2]
+        # an INFEASIBLE request must be rejected at the slice boundary,
+        # never fail the healthy tenant (journaled resize_rejected)
+        sched.resize("b", (3, 1, 1))
+        sched.run()
+        res = sched.results()
+        assert sched.job("a").state == "done"
+        assert sched.job("b").state == "done"
+    finally:
+        sched.close()
+
+    assert np.array_equal(np.asarray(res["b"]["T"]), b_solo)
+    plan = build_reshard_plan(
+        _topo(), (1, 2, 2), {"T": (a_solo.shape, str(a_solo.dtype), 0)})
+    assert np.array_equal(np.asarray(res["a"]["T"]),
+                          apply_plan_host(plan, {"T": a_solo})["T"])
+
+    evs = [json.loads(line)
+           for line in open(os.path.join(fd, "scheduler.jsonl"))]
+    kinds = [e.get("kind") for e in evs]
+    assert "control" in kinds
+    jr = next(e for e in evs if e.get("kind") == "job_resized")
+    assert jr["job"] == "a" and jr["new_dims"] == [1, 2, 2]
+    assert jr["via"] == "device" and jr["rounds"] > 0
+    tc = next(e for e in evs if e.get("kind") == "job_tuned_cleared")
+    assert tc["job"] == "a" and tc["reason"] == "resize"
+    rj = next(e for e in evs if e.get("kind") == "resize_rejected")
+    assert rj["job"] == "b" and "divide" in rj["error"]
+    # unknown job / finished job exit codes
+    assert _cli(["jobs", "resize", fd, "nope", "1,2,2"]) == 3
+    assert _cli(["jobs", "resize", fd, "a", "2,2,1"]) == 4
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# the slow matrix: dims x dtype x periodicity on device
+# ---------------------------------------------------------------------------
+
+def test_scheduler_survives_malformed_resize_dims(tmp_path):
+    """A hand-written control file whose ``new_dims`` are not integers
+    (an operator typo) must journal ``resize_rejected`` — it is a valid
+    JSON dict, so only `MeshScheduler.resize`'s int() coercion catches
+    it, and that ValueError must not take down the scheduler."""
+    from implicitglobalgrid_tpu.service import (
+        JobSpec, MeshScheduler, builtin_setup,
+    )
+
+    fd = str(tmp_path / "svc")
+    sched = MeshScheduler(policy="fifo", flight_dir=fd)
+    try:
+        sched.submit(JobSpec(
+            name="a", setup=builtin_setup("diffusion3d", "float32"),
+            nt=6, grid=dict(nx=6, ny=6, nz=6, dimx=2, dimy=2, dimz=1),
+            run=igg.RunSpec(nt_chunk=3, key=("rs_badctl", "a"))))
+        ctl = os.path.join(fd, "control")
+        os.makedirs(ctl, exist_ok=True)
+        with open(os.path.join(ctl, "resize_a"), "w",
+                  encoding="utf-8") as f:
+            json.dump({"new_dims": ["two", 2, 2]}, f)
+        sched.run()                      # must not raise
+        assert sched.job("a").state == "done"
+    finally:
+        sched.close()
+
+    evs = [json.loads(line)
+           for line in open(os.path.join(fd, "scheduler.jsonl"))]
+    rj = [e for e in evs if e.get("kind") == "resize_rejected"]
+    assert len(rj) == 1 and rj[0]["job"] == "a"
+    assert "two" in rj[0]["error"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("src,dst,per", [
+    ((2, 2, 1), (2, 1, 1), (0, 0, 0)),   # shrink: 4 -> 2 devices
+    ((1, 2, 1), (2, 2, 2), (1, 0, 1)),   # grow: 2 -> 8, periodic axes
+    ((2, 2, 2), (4, 2, 1), (0, 1, 0)),   # cubic fold
+])
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+def test_on_device_matrix_matches_oracle(src, dst, per, dtype):
+    """The dims x dtype x periodicity matrix: the compiled collective
+    program reproduces the host oracle byte-for-byte, staggered field
+    included, grow and shrink both directions."""
+    igg.init_global_grid(6, 6, 6, dimx=src[0], dimy=src[1], dimz=src[2],
+                         periodx=per[0], periody=per[1], periodz=per[2],
+                         quiet=True)
+    rng = np.random.default_rng(7)
+    T = igg.device_put_g(rng.normal(
+        size=tuple(src[d] * 6 for d in range(3))).astype(dtype))
+    P = igg.device_put_g(rng.normal(
+        size=(src[0] * 7, src[1] * 6, src[2] * 6)).astype(dtype))
+    state = {"T": T, "P": P}
+    host = {k: np.asarray(v) for k, v in state.items()}
+    plan = build_reshard_plan(live_topology(), dst,
+                              fields_of_state(state))
+    expect = apply_plan_host(plan, host)
+    new_state, info = reshard_state(state, dst, audit=True)
+    assert info["audit_report"].ok, \
+        [f.message for f in info["audit_report"].findings]
+    assert tuple(int(d) for d in igg.global_grid().dims) == dst
+    for k in state:
+        assert np.array_equal(np.asarray(new_state[k]), expect[k]), k
+
+
+@pytest.mark.slow
+@pytest.mark.ensemble
+def test_ensemble_on_device_resize_per_member(tmp_path):
+    """resize under ensemble=E: the batched state re-blocks on device
+    with each member bit-identical to the re-block of its own slice."""
+    from implicitglobalgrid_tpu.models import ensemble_state
+
+    E = 3
+    igg.init_global_grid(6, 6, 6, dimx=2, dimy=2, dimz=1, quiet=True)
+    x, y, z = igg.coords_g(0.5, 0.5, 0.5, igg.zeros_g())
+    T = igg.device_put_g(np.asarray(x + 10 * y + 100 * z))
+    Te = ensemble_state(T, E, perturb=0.01)
+    members = [np.asarray(Te[m]) for m in range(E)]
+    state = {"T": Te}
+    plan = build_reshard_plan(live_topology(), (1, 2, 2),
+                              fields_of_state(state))
+    new_state, _ = reshard_state(state, (1, 2, 2))
+    got = np.asarray(new_state["T"])
+    solo_plan = build_reshard_plan(
+        _topo(), (1, 2, 2),
+        {"T": (members[0].shape, str(members[0].dtype), 0)})
+    for m in range(E):
+        expect = apply_plan_host(solo_plan, {"T": members[m]})["T"]
+        assert np.array_equal(got[m], expect), f"member {m}"
+    igg.finalize_global_grid()
+
+
+@pytest.mark.slow
+def test_reshard_cli_run_audits_and_verifies(capsys):
+    from implicitglobalgrid_tpu.tools import _cli
+
+    rc = _cli(["reshard", "run", "--src-dims", "2,2,1",
+               "--dst-dims", "1,2,2", "--nx", "6", "--ensemble", "2",
+               "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    rec = json.loads(out)
+    assert rec["ok"] and rec["verified"] and rec["audit"]["ok"]
+    assert rec["plan"]["rounds"] > 0
