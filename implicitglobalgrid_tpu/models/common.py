@@ -306,11 +306,13 @@ def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
         # keying on them keeps the documented IGG_MP_HANDOFF /
         # IGG_PLANE_RELAY A/B flips honest within one grid epoch (no
         # stale cached runner). Same rule for the halo exchange knobs
-        # (IGG_HALO_COALESCE / IGG_HALO_WIRE_DTYPE), resolved at trace
-        # time inside `local_update_halo` calls in the step body.
+        # (IGG_HALO_COALESCE / IGG_HALO_WIRE_DTYPE / IGG_HALO_WIRE_STAGE),
+        # resolved at trace time inside `local_update_halo` calls in the
+        # step body.
         from ..ops.halo import resolve_halo_coalesce
         from ..ops.pallas_stencil import kernel_flags
         from ..ops.precision import resolve_wire_dtype
+        from ..ops.wire import resolve_wire_stage
 
         hook_id = None if post_chunk is None else (
             getattr(post_chunk, "__module__", None),
@@ -318,7 +320,8 @@ def make_state_runner(step_local, state_ndims, *, nt_chunk: int, key=None,
         full_key = (gg.epoch, key, tuple(state_ndims), int(nt_chunk),
                     bool(check_vma), int(unroll), kernel_flags(),
                     resolve_halo_coalesce(None),
-                    str(resolve_wire_dtype(None)), hook_id, ensemble)
+                    str(resolve_wire_dtype(None)),
+                    str(resolve_wire_stage(None)), hook_id, ensemble)
         fn = _runner_cache.get(full_key)
         if fn is not None:
             # telemetry: compiled-chunk reuse vs recompile is THE

@@ -70,7 +70,8 @@ from .hooks import account_halo_exchange, note_heartbeat, \
 from .perfdb import metric_direction, perfdb_add, perfdb_check, perfdb_load
 from .perfmodel import (
     MachineProfile, PerfWatch, STEP_WORKLOADS, StepWorkload,
-    default_machine_profile, load_machine_profile, predict_reshard,
+    default_machine_profile, hierarchical_machine_profile,
+    load_machine_profile, predict_reshard,
     predict_step, save_machine_profile,
 )
 from .recorder import (
@@ -105,7 +106,8 @@ __all__ = [
     "note_runner_cache", "account_halo_exchange", "observe_checkpoint",
     "note_heartbeat",
     "MachineProfile", "StepWorkload", "STEP_WORKLOADS", "PerfWatch",
-    "default_machine_profile", "load_machine_profile",
+    "default_machine_profile", "hierarchical_machine_profile",
+    "load_machine_profile",
     "save_machine_profile", "predict_step", "predict_reshard",
     "calibrate_machine",
     "metric_direction", "perfdb_add", "perfdb_check", "perfdb_load",

@@ -21,6 +21,13 @@ an explanatory error (mirroring the reference's legacy-var rejection at
   halo pack path.
 - ``IGG_TPU_DCN_AXES``: comma-separated mesh axes ("x","y","z") that cross
   slice boundaries (DCN) in a multi-slice deployment.
+- ``IGG_TPU_DCN_GRANULES``: per-axis DCN granule counts (``"z:2"`` /
+  ``"x:2,z:2"``) — how many ICI granules (slices/hosts) the mesh spans
+  along each axis. On real multi-slice pools `init_global_grid` derives
+  this from the device pool's slice structure; the env var declares it
+  for single-granule dev boxes (CPU meshes, contract fixtures) so the
+  topology-staged wire (`IGG_HALO_WIRE_STAGE`) and its pricing/contract
+  layers see the granule shape they would see on the pod.
 """
 
 from __future__ import annotations
@@ -60,6 +67,7 @@ class EnvConfig:
     # tri-state per dim: None = unset (resolved at init: True on TPU grids,
     # False elsewhere), True/False = explicit env setting
     dcn_axes: tuple = ()                   # IGG_TPU_DCN_AXES
+    dcn_granules: tuple = (1, 1, 1)        # IGG_TPU_DCN_GRANULES
 
 
 def read_env_config() -> EnvConfig:
@@ -101,4 +109,45 @@ def read_env_config() -> EnvConfig:
                 f"Environment variable IGG_TPU_DCN_AXES: duplicate axis name(s) in {names}."
             )
         cfg.dcn_axes = names
+
+    gran = os.environ.get("IGG_TPU_DCN_GRANULES", "")
+    if gran:
+        per_dim = [1, 1, 1]
+        seen = set()
+        for part in gran.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" not in part:
+                raise InvalidArgumentError(
+                    f"Environment variable IGG_TPU_DCN_GRANULES: entry {part!r} "
+                    "must be '<axis>:<count>' (e.g. 'z:2')."
+                )
+            axis, cnt = part.split(":", 1)
+            axis = axis.strip()
+            dim = {"x": 0, "y": 1, "z": 2}.get(axis)
+            if dim is None:
+                raise InvalidArgumentError(
+                    f"Environment variable IGG_TPU_DCN_GRANULES: invalid axis name {axis!r}; "
+                    "valid names are x, y, z."
+                )
+            if dim in seen:
+                raise InvalidArgumentError(
+                    f"Environment variable IGG_TPU_DCN_GRANULES: duplicate axis name {axis!r}."
+                )
+            seen.add(dim)
+            try:
+                n = int(cnt.strip())
+            except ValueError as e:
+                raise InvalidArgumentError(
+                    f"Environment variable IGG_TPU_DCN_GRANULES: granule count for axis "
+                    f"{axis!r} must be an integer >= 1, got {cnt!r}."
+                ) from e
+            if n < 1:
+                raise InvalidArgumentError(
+                    f"Environment variable IGG_TPU_DCN_GRANULES: granule count for axis "
+                    f"{axis!r} must be >= 1, got {n}."
+                )
+            per_dim[dim] = n
+        cfg.dcn_granules = tuple(per_dim)
     return cfg

@@ -1,0 +1,454 @@
+"""Topology-staged hierarchical wire (ISSUE 16) — on `ops.wire`'s
+`StagedWireSchema`, `parallel.topology`'s `staged_wire_layout`,
+`ops.halo`'s staged exchange path, the staged multi-stage contracts, the
+staged `predict_step` pricing, and the tuner's staged-vs-flat selection.
+
+THE claim under test: a DCN-crossing axis's exchange can be re-routed as
+ICI leader-gather -> ONE striped DCN transfer per granule pair -> ICI
+scatter (HiCCL-style hierarchical composition, arXiv:2408.05962), cutting
+the per-DCN-link message count by the ICI fold while delivering halos
+BIT-IDENTICAL to the flat wire — with the flat path byte-for-byte
+untouched when staging is off.
+
+Tier-1 keeps one fast representative per behavior (policy parsing, the
+layout geometry, ONE live bit-identity leg, the golden-fixture contract,
+the staged pricing verdict, the model-only tuner selection); the
+composition matrix (quantized x staggered x ensemble x non-periodic), the
+compiled audit legs, and the subprocess exit-1 gate ride the slow tier.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.utils.exceptions import InvalidArgumentError
+
+pytestmark = pytest.mark.stage
+
+_FIXTURE_DIMS = dict(dimx=4, dimy=1, dimz=2)  # z = DCN axis, x = gather
+
+
+def _init_fixture_grid(monkeypatch, periodz=1, periodx=1):
+    """The canonical two-granule mesh of the golden fixture: 4x1x2 with
+    z split into 2 DCN granules (x is the fold-4 ICI gather axis)."""
+    monkeypatch.setenv("IGG_TPU_DCN_GRANULES", "z:2")
+    igg.init_global_grid(8, 8, 8, periodx=periodx, periody=1,
+                         periodz=periodz, quiet=True, **_FIXTURE_DIMS)
+
+
+# ---------------------------------------------------------------------------
+# policy + layout units (host-only)
+
+def test_resolve_wire_stage_spellings():
+    """`resolve_wire_stage` mirrors the wire-dtype policy family: bare /
+    per-axis / dict spellings, off synonyms, env fallback, passthrough —
+    and every all-off spelling collapses to None (the flat wire)."""
+    from implicitglobalgrid_tpu.ops.wire import (
+        WireStagePolicy, resolve_wire_stage,
+    )
+
+    p = resolve_wire_stage("z:staged")
+    assert isinstance(p, WireStagePolicy)
+    assert p.staged_dims == (2,)
+    assert str(p) == "z:staged"
+    assert resolve_wire_stage(p) is p  # passthrough
+    assert resolve_wire_stage({"z": "staged"}).staged_dims == (2,)
+    assert resolve_wire_stage("staged").staged_dims == (0, 1, 2)
+    for off in (None, "", "0", "off", "none", "flat", "z:off"):
+        assert resolve_wire_stage(off) is None, off
+    with pytest.raises(InvalidArgumentError):
+        resolve_wire_stage("z:sideways")
+    # env fallback: resolve(None) reads IGG_HALO_WIRE_STAGE
+    saved = os.environ.get("IGG_HALO_WIRE_STAGE")
+    try:
+        os.environ["IGG_HALO_WIRE_STAGE"] = "z:staged"
+        assert str(resolve_wire_stage(None)) == "z:staged"
+    finally:
+        if saved is None:
+            os.environ.pop("IGG_HALO_WIRE_STAGE", None)
+        else:
+            os.environ["IGG_HALO_WIRE_STAGE"] = saved
+
+
+def test_staged_wire_layout_geometry(monkeypatch):
+    """On the fixture mesh the z layout gathers over x (the largest
+    perpendicular pure-ICI axis): fold 4, 2 granules, and exactly ONE
+    DCN-crossing transfer per granule pair per direction — while
+    degenerate axes (unsplit, undeclared, or no perpendicular ICI
+    candidate) carry no layout at all."""
+    from implicitglobalgrid_tpu.parallel.topology import staged_wire_layout
+
+    _init_fixture_grid(monkeypatch)
+    try:
+        gg = igg.global_grid()
+        lay = staged_wire_layout(gg, 2)
+        assert lay is not None
+        assert (lay.gather_dim, lay.fold, lay.granules) == (0, 4, 2)
+        for dr in lay.directions:
+            # one striped DCN transfer per crossing granule pair: the
+            # leaders' pairs only (non-leaders ride PROC_NULL), where the
+            # flat wire pays fold device-pairs per granule pair
+            assert len(dr.dcn_pairs) == len(dr.cross_pairs)
+            assert len(dr.gather_pairs) > 0 and len(dr.scatter_pairs) > 0
+        assert lay.dcn_pair_count * lay.fold == sum(
+            len(dr.cross_pairs) * lay.fold for dr in lay.directions)
+        # x and y are not staged axes: x has granules=1, y is unsplit
+        assert staged_wire_layout(gg, 0) is None
+        assert staged_wire_layout(gg, 1) is None
+    finally:
+        igg.finalize_global_grid()
+
+
+def test_undeclared_granules_mean_no_staging():
+    """Without declared DCN granules every axis is flat: staging resolves
+    but degrades to the identical flat wire (zero behavior change on
+    single-slice meshes — the degenerate-consistency guarantee)."""
+    from implicitglobalgrid_tpu.parallel.topology import staged_wire_layout
+
+    igg.init_global_grid(8, 8, 8, dimx=4, dimy=1, dimz=2, periodx=1,
+                         periody=1, periodz=1, quiet=True)
+    try:
+        gg = igg.global_grid()
+        assert gg.dcn_granules == (1, 1, 1)
+        assert staged_wire_layout(gg, 2) is None
+        A = igg.ones_g((8, 8, 8), np.float32)
+        plan = igg.halo_comm_plan(A, wire_stage="z:staged")
+        assert plan["staged_axes"] == ()
+        assert "staged" not in plan["axes"]["gz"]
+        assert plan["axes"]["gz"]["ppermutes"] == 2  # the flat pair
+    finally:
+        igg.finalize_global_grid()
+
+
+# ---------------------------------------------------------------------------
+# the static plan + pricing (host-only: nothing compiled)
+
+def test_staged_plan_counts_and_fold(monkeypatch):
+    """`halo_comm_plan(wire_stage="z:staged")` on the fixture mesh: the z
+    axis pays 2*(2F-1) = 14 permute ops per round (F=4 gather fold), the
+    DCN-crossing pair count drops 16 -> 4 (the fold), and the stage table
+    carries per-stage {direction, stage, ops, pairs, payload_bytes}."""
+    _init_fixture_grid(monkeypatch)
+    try:
+        A = igg.ones_g((8, 8, 8), np.float32)
+        plan = igg.halo_comm_plan(A, wire_stage="z:staged")
+        assert plan["wire_stage"] == "z:staged"
+        assert plan["staged_axes"] == ("gz",)
+        rec = plan["axes"]["gz"]
+        assert rec["ppermutes"] == 14
+        det = rec["staged"]
+        assert (det["fold"], det["granules"]) == (4, 2)
+        assert det["gather_axis"] == "gx"
+        assert (det["dcn_pairs"], det["flat_dcn_pairs"]) == (4, 16)
+        stages = {s["stage"] for s in det["stages"]}
+        assert stages == {"gather", "dcn", "scatter"}
+        # the DCN stage ships the F-slab stripe: payload = fold x slab
+        slab = next(s for s in det["stages"] if s["stage"] == "gather")
+        dcn = next(s for s in det["stages"] if s["stage"] == "dcn")
+        assert dcn["payload_bytes"] == det["fold"] * slab["payload_bytes"]
+        # the flat x axis is untouched by z staging
+        assert plan["axes"]["gx"]["ppermutes"] == 2
+        assert "staged" not in plan["axes"]["gx"]
+        # staging OFF: the very same plan as never having the knob
+        flat = igg.halo_comm_plan(A)
+        assert flat["wire_stage"] is None and flat["staged_axes"] == ()
+        assert flat["axes"]["gz"]["ppermutes"] == 2
+    finally:
+        igg.finalize_global_grid()
+
+
+def test_staged_pricing_verdict_on_hierarchical_profile(monkeypatch):
+    """`predict_step(wire_stage="z:staged")` on the canned hierarchical
+    ICI+DCN profile: each stage priced against its own link class, the
+    staged-vs-flat verdict says staged WINS on the DCN axis (the flat
+    alternative pays fold serialized messages per DCN bundle), the
+    embedded flat price equals the standalone flat pricing exactly, and
+    `bound_detail` names the wire_stage knob."""
+    import jax
+
+    from implicitglobalgrid_tpu.telemetry.perfmodel import (
+        hierarchical_machine_profile, predict_step,
+    )
+
+    _init_fixture_grid(monkeypatch)
+    try:
+        prof = hierarchical_machine_profile()
+        assert prof.meta.get("dcn_axes") == ["z"]
+        stacked = (32, 8, 16)
+        T = jax.ShapeDtypeStruct(stacked, np.float32)
+        Cp = jax.ShapeDtypeStruct(stacked, np.float32)
+        flat = predict_step("diffusion3d", (T, Cp), profile=prof)
+        staged = predict_step("diffusion3d", (T, Cp), profile=prof,
+                              wire_stage="z:staged")
+        assert staged["wire_stage"] == "z:staged"
+        det = staged["comm"]["gz"]["staged"]
+        assert det["wins"] is True
+        assert det["dcn_msgs_ratio"] == 4.0
+        assert det["staged_s"] < det["flat_s"]
+        # the flat alternative embedded in the verdict IS the flat
+        # pricing (fold messages serialize through one DCN bundle)
+        assert det["flat_s"] == pytest.approx(
+            flat["comm"]["gz"]["latency_s"] + flat["comm"]["gz"]["wire_s"],
+            rel=1e-9)
+        assert flat["comm"]["gz"]["dcn_msgs_per_link"] == 4
+        assert staged["step_s"] < flat["step_s"]
+        assert "wire_stage[z]" in (flat["bound_detail"] or "")
+    finally:
+        igg.finalize_global_grid()
+
+
+# ---------------------------------------------------------------------------
+# golden fixture: host-only parse + multi-stage contract
+
+def test_parse_staged_dcn_fixture(monkeypatch):
+    """The checked-in staged exchange program (4x1x2 mesh, z staged over
+    2 granules): 16 permutes total — the flat x pair plus z's
+    2*(2F-1)=14 staged ops — honoring the multi-stage contract
+    byte-exactly, with exactly one DCN-crossing stripe transfer per
+    granule pair per direction; an injected WRONG-stage (flat) contract
+    is caught."""
+    from implicitglobalgrid_tpu.analysis import (
+        check_contract, exchange_contract, parse_text,
+    )
+
+    fix = os.path.join(os.path.dirname(__file__), "data", "hlo",
+                       "exchange_staged_dcn.hlo.txt")
+    with open(fix, encoding="utf-8") as f:
+        ir = parse_text(f.read())
+    assert ir.dialect == "hlo" and ir.module == "jit_exchange"
+    assert len(ir.permutes) == 16
+    assert not ir.all_reduces and not ir.all_gathers and not ir.all_to_alls
+    # the two DCN stripes: payload f32[4,8,8,1] (fold x slab), one
+    # directed leader pair per granule pair per direction
+    leaders = frozenset({(0, 1), (1, 0)})
+    stripes = [op for op in ir.permutes
+               if ir.payload_of(op).dims[0] == 4
+               and frozenset(op.attrs["source_target_pairs"]) == leaders]
+    assert len(stripes) == 2
+    for op in stripes:
+        pay = ir.payload_of(op)
+        assert pay.dims == (4, 8, 8, 1) and pay.nbytes == 4 * 256
+
+    _init_fixture_grid(monkeypatch)
+    try:
+        args = (np.zeros((32, 8, 16), np.float32),)
+        contract = exchange_contract(*args, wire_stage="z:staged")
+        assert check_contract(ir, contract) == []
+        # wrong-stage injection: the FLAT contract must fail loudly
+        wrong = exchange_contract(*args)
+        findings = check_contract(ir, wrong)
+        assert findings and all(f.severity == "error" for f in findings)
+    finally:
+        igg.finalize_global_grid()
+
+
+@pytest.mark.slow
+def test_tools_audit_exit1_on_wrong_stage_contract(monkeypatch, tmp_path):
+    """The CLI gate end-to-end: ``tools audit --hlo <staged fixture>
+    --contract <flat contract>`` exits 1 (the injected wrong-stage
+    contract), and with the STAGED contract exits 0."""
+    fix = os.path.join(os.path.dirname(__file__), "data", "hlo",
+                       "exchange_staged_dcn.hlo.txt")
+    from implicitglobalgrid_tpu.analysis import exchange_contract
+
+    _init_fixture_grid(monkeypatch)
+    try:
+        args = (np.zeros((32, 8, 16), np.float32),)
+        good = exchange_contract(*args, wire_stage="z:staged")
+        wrong = exchange_contract(*args)
+    finally:
+        igg.finalize_global_grid()
+    rcs = {}
+    for name, contract in (("good", good), ("wrong", wrong)):
+        path = tmp_path / f"{name}.json"
+        path.write_text(json.dumps(contract.to_json()))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "implicitglobalgrid_tpu.tools",
+             "audit", "--hlo", fix, "--contract", str(path)],
+            capture_output=True, text=True, env=env)
+        rcs[name] = r.returncode
+    assert rcs == {"good": 0, "wrong": 1}, rcs
+
+
+# ---------------------------------------------------------------------------
+# live bit-identity: staged == flat
+
+def _assert_bit_identical(fields, wire_dtype=None):
+    flat = igg.update_halo(*fields, wire_dtype=wire_dtype)
+    staged = igg.update_halo(*fields, wire_dtype=wire_dtype,
+                             wire_stage="z:staged")
+    flat = flat if isinstance(flat, tuple) else (flat,)
+    staged = staged if isinstance(staged, tuple) else (staged,)
+    for f, s in zip(flat, staged):
+        assert np.array_equal(np.asarray(f), np.asarray(s))
+
+
+def test_staged_bit_identical_fast_representative(monkeypatch):
+    """ONE fast tier-1 leg of the bit-identity guarantee: periodic-z
+    fixture mesh, a regular and a staggered field together — the staged
+    route is pure re-routing of the same packed slabs, so delivered
+    halos match the flat wire bit for bit."""
+    rng = np.random.default_rng(16)
+    _init_fixture_grid(monkeypatch)
+    try:
+        T = np.asarray(rng.normal(size=(32, 8, 16)), np.float32)
+        V = np.asarray(rng.normal(size=(36, 8, 16)), np.float32)
+        _assert_bit_identical((T, V))
+    finally:
+        igg.finalize_global_grid()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("periodz,periodx,wire", [
+    (0, 1, None),        # non-periodic staged axis (one-sided crossings)
+    (1, 1, "z:int8"),    # quantized staged axis: scales ride in-band
+    (0, 0, "int8"),      # all-axis quantized x non-periodic
+    (1, 0, "bfloat16"),  # float-cast wire through the stripe
+])
+def test_staged_bit_identical_matrix(monkeypatch, periodz, periodx, wire):
+    """The composition matrix behind the fast representative: staged ==
+    flat bit-identical across periodicity and every wire-format family
+    (the quantized per-slab scales ride in-band through all three
+    stages)."""
+    rng = np.random.default_rng(7)
+    _init_fixture_grid(monkeypatch, periodz=periodz, periodx=periodx)
+    try:
+        T = np.asarray(rng.normal(size=(32, 8, 16)), np.float32)
+        V = np.asarray(rng.normal(size=(36, 8, 16)), np.float32)
+        _assert_bit_identical((T, V), wire_dtype=wire)
+    finally:
+        igg.finalize_global_grid()
+
+
+@pytest.mark.slow
+def test_staged_bit_identical_ensemble_leg(monkeypatch):
+    """The ensemble leg: an E=2 member-batched exchange chunk delivers
+    bit-identical state staged vs flat (the vmapped member axis rides
+    each stage's payload exactly like the flat pair's)."""
+    from implicitglobalgrid_tpu.models.common import (
+        ensemble_state, make_state_runner,
+    )
+
+    rng = np.random.default_rng(3)
+    _init_fixture_grid(monkeypatch)
+    try:
+        T = np.asarray(rng.normal(size=(32, 8, 16)), np.float32)
+        ET = ensemble_state(igg.device_put_g(T), 2, perturb=0.25)
+        outs = {}
+        for mode, ws in (("flat", None), ("staged", "z:staged")):
+            def step(s, ws=ws):
+                return (igg.local_update_halo(s[0], wire_stage=ws),)
+
+            run = make_state_runner(step, (3,), nt_chunk=2, ensemble=2,
+                                    key=("stage_ens", mode))
+            outs[mode] = np.asarray(run(ET)[0])
+        assert np.array_equal(outs["flat"], outs["staged"])
+    finally:
+        igg.finalize_global_grid()
+
+
+# ---------------------------------------------------------------------------
+# compiled audit legs (contract + crosscheck against the live compiler)
+
+@pytest.mark.audit
+def test_audit_model_staged_green(monkeypatch):
+    """`audit_model(wire_stage="z:staged")` on the fixture mesh: the
+    staged diffusion step honors the multi-stage contract and the
+    `perfmodel_crosscheck` leg — and a flat audit of the SAME
+    granule-declared grid right after stays green (no staged leakage
+    through the runner cache)."""
+    from implicitglobalgrid_tpu.analysis import audit_model
+
+    _init_fixture_grid(monkeypatch)
+    try:
+        rep = audit_model("diffusion3d", wire_stage="z:staged")
+        assert rep.ok, [f.to_json() for f in rep.findings]
+        assert rep.crosscheck["ok"]
+        assert rep.crosscheck["wire_stage"] == "z:staged"
+        flat = audit_model("diffusion3d")
+        assert flat.ok, [f.to_json() for f in flat.findings]
+    finally:
+        igg.finalize_global_grid()
+
+
+@pytest.mark.slow
+@pytest.mark.audit
+def test_audit_model_staged_composed_with_quant(monkeypatch):
+    """The acceptance composition: staged + ``wire_dtype="z:int8"`` —
+    contract and crosscheck green with the quantized payload bytes
+    riding every stage."""
+    from implicitglobalgrid_tpu.analysis import audit_model
+
+    _init_fixture_grid(monkeypatch)
+    try:
+        rep = audit_model("diffusion3d", wire_stage="z:staged",
+                          wire_dtype="z:int8")
+        assert rep.ok, [f.to_json() for f in rep.findings]
+        assert rep.crosscheck["ok"]
+    finally:
+        igg.finalize_global_grid()
+
+
+# ---------------------------------------------------------------------------
+# the tuner learns staged-vs-flat
+
+@pytest.mark.tune
+def test_tune_selects_staged_on_hierarchical_profile(monkeypatch):
+    """Model-only search on the canned hierarchical ICI+DCN profile: the
+    staged candidate prices ahead of flat for the DCN axis and wins —
+    while the SAME search on a flat (grid-derived default) profile keeps
+    the flat wire (staged never regresses where links are uniform)."""
+    from implicitglobalgrid_tpu.telemetry.perfmodel import (
+        hierarchical_machine_profile,
+    )
+    from implicitglobalgrid_tpu.telemetry.tune import tune_config
+
+    monkeypatch.setenv("IGG_TPU_DCN_GRANULES", "z:2")
+    grid = dict(nx=32, ny=8, nz=16, periodz=1, **_FIXTURE_DIMS)
+    cfg = tune_config("diffusion3d", grid,
+                      profile=hierarchical_machine_profile(),
+                      comm_every_options=("1",),
+                      wire_stage_options=(None, "z:staged"),
+                      measure=False)
+    assert cfg.wire_stage == "z:staged"
+    assert cfg.env()["IGG_HALO_WIRE_STAGE"] == "z:staged"
+    flat_cfg = tune_config("diffusion3d", grid,
+                           comm_every_options=("1",),
+                           wire_stage_options=(None, "z:staged"),
+                           measure=False)
+    assert flat_cfg.wire_stage is None
+    # unset staging adds NO env key (the exact-3-key driver contract)
+    assert "IGG_HALO_WIRE_STAGE" not in flat_cfg.env()
+    # the knob round-trips through the persisted JSON record
+    from implicitglobalgrid_tpu.telemetry.tune import TunedConfig
+
+    assert TunedConfig.from_json(cfg.to_json()).wire_stage == "z:staged"
+
+
+@pytest.mark.slow
+@pytest.mark.tune
+def test_tune_measured_staged_never_loses(monkeypatch):
+    """Measured validation on the CPU mesh (no real DCN): the staged
+    candidate may price well on a hierarchical profile but the MEASURED
+    winner decides — `tune_config` keeps the >= 1.0 speedup guarantee
+    with staged in the candidate set (model and measurement must agree
+    before staged ships)."""
+    from implicitglobalgrid_tpu.telemetry.perfmodel import (
+        hierarchical_machine_profile,
+    )
+    from implicitglobalgrid_tpu.telemetry.tune import tune_config
+
+    monkeypatch.setenv("IGG_TPU_DCN_GRANULES", "z:2")
+    grid = dict(nx=16, ny=8, nz=8, periodz=1, **_FIXTURE_DIMS)
+    cfg = tune_config("diffusion3d", grid,
+                      profile=hierarchical_machine_profile(),
+                      comm_every_options=("1",),
+                      wire_stage_options=(None, "z:staged"),
+                      measure=True, top_k=2, measure_steps=2, reps=2)
+    assert cfg.speedup is not None and cfg.speedup >= 1.0
