@@ -284,6 +284,20 @@ def main() -> None:
     for row in bench_service.run_service_overhead(dims3, cpu):
         results.append(bench_util.emit(row))
 
+    # --- serving tier: job API round trip + read-side query cache ----------
+    # the HTTP front doors (ISSUE 17): submit+status round trip against a
+    # live JobApiServer, cold sub-box snapshot read over HTTP, and the
+    # block-LRU cold/warm speedup — the warm read answers from decoded
+    # blocks, so `query_cache_speedup >= 1.0` is an absolute gate (rc 1
+    # under IGG_BENCH_STRICT=1); the latencies ride the perfdb trajectory.
+    # Config owned by `bench_service.run_serving_tier`.
+    serve_rows = bench_service.run_serving_tier(dims3, cpu)
+    for row in serve_rows:
+        results.append(bench_util.emit(row))
+    query_speedup = next(r["value"] for r in serve_rows
+                         if r["metric"] == "query_cache_speedup")
+    serve_ok = query_speedup is None or query_speedup >= 1.0
+
     # --- static analysis: compile-time audit overhead ----------------------
     # run_resilient(audit=True)'s one-time trace+lower+parse+check cost as
     # a fraction of run time; target < 2% (ISSUE 7). Config owned by
@@ -355,7 +369,7 @@ def main() -> None:
     lint_failed = not ruff_missing and lint.returncode != 0
     if (not gate["ok"] or lint_failed or not coalesce8_ok
             or not ensemble_ok or not tuned_ok or not reshard_ok
-            or not staged_ok) \
+            or not staged_ok or not serve_ok) \
             and os.environ.get("IGG_BENCH_STRICT") == "1":
         sys.exit(1)
 

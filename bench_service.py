@@ -38,6 +38,7 @@ Usage: python bench_service.py          (real chip)
 
 from __future__ import annotations
 
+import json
 import sys
 import time
 
@@ -130,6 +131,107 @@ def run_service_overhead(dims, cpu: bool):
     }]
 
 
+def run_serving_tier(dims, cpu: bool):
+    """Serving-tier legs (ISSUE 17), shared with `bench_all.py`:
+
+    - ``api_roundtrip_s``: median submit+status HTTP round trip against
+      a live `serve.JobApiServer` (loopback, ephemeral port) — the
+      front-door latency a tenant pays per job, queue-record write and
+      journal-derived status read included.
+    - ``query_read_s``: cold sub-box read of a committed snapshot over
+      HTTP (`serve.SnapshotQueryServer`) — checksum verify + block
+      decode + O(box) assembly + npy streaming.
+    - ``query_cache_speedup``: cold / warm for the SAME box — the warm
+      read answers from the block LRU (decoded once across clients), so
+      this must never drop below 1.0 (absolute gate under
+      IGG_BENCH_STRICT=1; cold medianed over fresh-cache servers so one
+      slow first open cannot fake a speedup)."""
+    import io
+    import statistics
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.serve import (
+        JobApiServer, SnapshotQueryServer,
+    )
+
+    rows = []
+
+    # -- job API round trip (host-only: no scheduler attached) --------------
+    d = tempfile.mkdtemp(prefix="bench_serve_api_")
+    reps = 20
+    with JobApiServer(d) as api:
+        u = f"http://{api.host}:{api.port}"
+        durs = []
+        for i in range(reps):
+            rec = json.dumps({"name": f"j{i:03d}", "model": "diffusion3d",
+                              "nt": 8, "run": {"nt_chunk": 4}}).encode()
+            t0 = time.perf_counter()
+            req = urllib.request.Request(u + "/v1/jobs", data=rec,
+                                         method="POST")
+            with urllib.request.urlopen(req) as r:
+                r.read()
+            with urllib.request.urlopen(u + f"/v1/jobs/j{i:03d}") as r:
+                r.read()
+            durs.append(time.perf_counter() - t0)
+    rows.append({
+        "metric": "api_roundtrip_s",
+        "value": statistics.median(durs),
+        "unit": "s per submit+status HTTP round trip (loopback)",
+        "requests": reps,
+    })
+
+    # -- read-side query: cold vs warm over one committed snapshot ----------
+    nx = 32 if cpu else 128
+    grid = dict(nx=nx, ny=nx, nz=nx, dimx=int(dims[0]), dimy=int(dims[1]),
+                dimz=int(dims[2]))
+    root = tempfile.mkdtemp(prefix="bench_serve_query_")
+    igg.init_global_grid(quiet=True, **grid)
+    T = igg.zeros_g() + 1.5
+    igg.write_snapshot(root, step=1, state={"T": T})
+    gx = int(igg.nx_g())
+    igg.finalize_global_grid()
+    box = f"1:{gx - 1},1:{gx - 1},0:{nx // 2}"  # spans every x/y block
+
+    def read_once(q):
+        u = f"http://{q.host}:{q.port}/v1/snapshots/1/T?box={box}"
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(u) as r:
+            body = r.read()
+        dt = time.perf_counter() - t0
+        return dt, np.load(io.BytesIO(body))
+
+    cold = []
+    for _ in range(5):  # fresh cache per server: honest cold medians
+        with SnapshotQueryServer(root) as q:
+            dt, arr = read_once(q)
+            cold.append(dt)
+    with SnapshotQueryServer(root) as q:
+        read_once(q)  # fill the LRU
+        warm = [read_once(q)[0] for _ in range(9)]
+        assert q.cache.stats()["hits"] > 0
+    cold_s = statistics.median(cold)
+    warm_s = statistics.median(warm)
+    rows.append({
+        "metric": "query_read_s",
+        "value": cold_s,
+        "unit": "s per cold sub-box HTTP read (verify+decode+assemble)",
+        "box": box,
+        "box_bytes": int(arr.nbytes),
+    })
+    rows.append({
+        "metric": "query_cache_speedup",
+        "value": cold_s / warm_s,
+        "unit": "x cold/warm for the same box (target >= 1.0)",
+        "target": 1.0,
+        "warm_s": warm_s,
+    })
+    return rows
+
+
 def main() -> None:
     cpu = "--cpu" in sys.argv
     if cpu:
@@ -149,6 +251,8 @@ def main() -> None:
     nd = len(jax.devices())
     dims = tuple(int(d) for d in igg.dims_create(nd, (0, 0, 0)))
     for row in run_service_overhead(dims, cpu):
+        bench_util.emit(row)
+    for row in run_serving_tier(dims, cpu):
         bench_util.emit(row)
 
 

@@ -93,6 +93,10 @@ from .service import (
     MeshScheduler, JobSpec, JobState, service_report,
     export_service_trace,
 )
+from . import serve
+from .serve import (
+    BlockCache, CachedSnapshot, JobApiServer, SnapshotQueryServer,
+)
 from . import analysis
 from .analysis import (
     AuditFinding, AuditReport, CollectiveContract, ProgramIR,
@@ -126,6 +130,9 @@ __all__ = [
     # multi-run scheduler (the mesh as a persistent simulation service)
     "service", "MeshScheduler", "JobSpec", "JobState", "service_report",
     "export_service_trace",
+    # serving tier (networked job API + read-side snapshot query service)
+    "serve", "JobApiServer", "SnapshotQueryServer", "BlockCache",
+    "CachedSnapshot",
     # on-device elastic resharding (HBM-to-HBM re-blocking, no disk)
     "reshard", "ReshardPlan", "build_reshard_plan", "reshard_contract",
     "reshard_state",

@@ -45,11 +45,23 @@ class Snapshot:
 
     def __init__(self, dirpath):
         self.path = os.fspath(dirpath)
+        base = os.path.basename(os.path.normpath(self.path))
+        if ".tmp-" in base or ".old-" in base:
+            # a staging (or moved-aside) directory is NOT a snapshot: a
+            # query-service replica polling a live root must get the
+            # typed refusal, never a torn read of a half-written set
+            raise IncoherentArgumentError(
+                f"{self.path} is an uncommitted staging directory "
+                "(.tmp-/.old- — an in-flight or interrupted writer); "
+                "only committed snapshot directories can be opened. "
+                "Use list_snapshots(root) — it never lists these.")
         if not os.path.isdir(self.path):
             raise InvalidArgumentError(
                 f"Snapshot directory not found: {self.path}")
         meta = load_prefixed_meta(self.path)
         self._meta = meta
+        tok = meta.get("save_token")
+        self.token = None if tok is None else str(tok)
         self.names = [str(n) for n in meta.get("names", ())]
         self.step = int(meta["step"]) if "step" in meta else None
         self._checksums = "checksums" in meta
@@ -147,9 +159,17 @@ class Snapshot:
                 sel_src.append(i_of[jj])
             key = shard_key(name, tuple(int(co[d]) * loc[d]
                                         for d in range(len(loc))))
-            block = np.asarray(find_block(key))
+            block = np.asarray(self._fetch_block(name, key, find_block))
             out[np.ix_(*sel_out)] = block[np.ix_(*sel_src)]
         return out
+
+    def _fetch_block(self, name: str, key: str, find_block):
+        """Block-fetch hook: the base reader just scans the shard files
+        (`block_scanner` — sha256-verified on first open). The serving
+        tier's `serve.CachedSnapshot` overrides this with a bounded LRU
+        keyed by (save token, field, block coordinate), so hot blocks
+        decode once ACROSS requests instead of once per read."""
+        return find_block(key)
 
     def read_point(self, name: str, index) -> float:
         """One global cell (the CLI probe's engine): O(1 block) read."""

@@ -261,6 +261,18 @@ class ResilientRun:
         # scheduler clears it at the next slice boundary
         self.tuned_stale = False
         self.tuned_stale_reason = None
+        # wall-clock deadline surface (RunSpec.deadline_s): crossing the
+        # budget fires ONE deadline_missed flight event + counter at the
+        # next boundary — observability, never a kill
+        if spec.deadline_s is not None \
+                and not float(spec.deadline_s) > 0:
+            raise InvalidArgumentError(
+                f"RunSpec.deadline_s is a wall-clock budget in seconds "
+                f"(> 0); got {spec.deadline_s!r}.")
+        self.deadline_s = (None if spec.deadline_s is None
+                           else float(spec.deadline_s))
+        self.deadline_missed = False
+        self._deadline_t0 = time.monotonic()
         if spec.audit_lints is not None and not spec.audit:
             raise InvalidArgumentError(
                 "audit_lints selects rules for the compile-time audit — it "
@@ -649,10 +661,31 @@ class ResilientRun:
             self._iterate()
         if self.step >= self.nt and not self._finished:
             self._note_heartbeat(self.step)
+            # a run that crossed its budget inside the FINAL chunk still
+            # reports it (no further boundary would check)
+            self._check_deadline()
             self._record_event("run_end", completed=self.step,
                                chunks=self.chunk_idx)
             self._finished = True
         return not self._finished
+
+    def _check_deadline(self) -> None:
+        """Boundary-granular deadline watch: past the ``deadline_s``
+        budget, record ONE ``deadline_missed`` flight event and bump
+        ``igg_job_deadline_missed_total`` — the run keeps going (a
+        deadline is an operator contract, not a kill switch; the
+        scheduler journals it and `service_report` surfaces it)."""
+        if self.deadline_s is None or self.deadline_missed:
+            return
+        elapsed_s = time.monotonic() - self._deadline_t0
+        if elapsed_s > self.deadline_s:
+            self.deadline_missed = True
+            from ..telemetry.hooks import note_deadline_missed
+
+            note_deadline_missed()
+            self._record_event("deadline_missed", step=self.step,
+                               deadline_s=self.deadline_s,
+                               elapsed_s=elapsed_s)
 
     def _iterate(self):
         np = self._np
@@ -669,6 +702,7 @@ class ResilientRun:
         # elastic-restart paths all come back through here): the /healthz
         # age resets as long as the driver is making progress
         self._note_heartbeat(self.step)
+        self._check_deadline()
         step = self.step
         # --- faults due at this boundary (chunks split on them) ----------
         for f in [f for f in self.pending

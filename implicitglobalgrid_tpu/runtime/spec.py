@@ -44,6 +44,11 @@ class RunSpec:
       chunk program; every state array leads with the member axis — build
       with `models.common.ensemble_state` — and the guard trips per
       member)
+    - deadline: ``deadline_s`` (wall-clock budget from the run's start;
+      crossing it fires ONE ``deadline_missed`` flight event + the
+      ``igg_job_deadline_missed_total`` counter at the next step
+      boundary — observability, never a kill: the run completes. The
+      scheduler fills it from ``JobSpec.deadline_s`` minus queue wait)
     - auto-tuner: ``tuned`` (a `telemetry.TunedConfig`, its JSON dict, or
       a path to one — `telemetry.tune_config` output). The driver scopes
       the config's TRACE-TIME knobs (``IGG_COMM_EVERY`` /
@@ -80,6 +85,7 @@ class RunSpec:
     audit_lints: Any = None
     ensemble: int | None = None
     tuned: Any = None
+    deadline_s: float | None = None
 
     def to_json(self) -> dict:
         """JSON-able summary of the NON-DEFAULT, serializable knobs (for

@@ -1,0 +1,29 @@
+"""Serving tier: the framework's networked front doors.
+
+Two stateless HTTP services turn a scheduler flight directory and a
+snapshot root into network surfaces, so tenants and dashboards need
+neither a filesystem mount nor an accelerator runtime:
+
+- `JobApiServer` (`serve.api`) — the WRITE side: versioned JSON job
+  API over one flight directory. Submissions become queue-backend
+  records a live `service.MeshScheduler` claims; cancel/resize/drain
+  become the exact control files ``tools jobs`` writes; status is
+  re-derived from the journal (`service_report`'s source).
+- `SnapshotQueryServer` (`serve.query`) — the READ side: O(box)
+  sub-box reads of any committed snapshot, streamed as ``.npy`` bytes,
+  answered through a bounded `BlockCache` LRU (`serve.cache`) of
+  checksum-verified decoded blocks. Replicas never touch the mesh.
+
+Both ride on `telemetry.MetricsServer` (``routes=``), so every
+endpoint also serves ``/metrics`` + ``/healthz`` and binds loopback by
+default. See docs/serving.md for the API reference and deployment
+notes.
+"""
+
+from .api import JobApiServer
+from .cache import BlockCache, CachedSnapshot
+from .query import SnapshotQueryServer
+
+__all__ = [
+    "JobApiServer", "SnapshotQueryServer", "BlockCache", "CachedSnapshot",
+]

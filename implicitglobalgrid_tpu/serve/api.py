@@ -1,0 +1,217 @@
+"""`JobApiServer` — the networked job front door (serving tier leg a).
+
+A versioned JSON HTTP surface over one scheduler flight directory. It
+owns NO scheduler state: submissions become queue-backend records
+(`service.DirectoryBackend` — the atomic-rename claim protocol a live
+`MeshScheduler` polls), control verbs become the exact control files
+``tools jobs cancel|resize|drain`` writes, and status is reconstructed
+from the journal — the same source `service_report` reads. The API
+writes exactly what the CLI writes, so a live scheduler needs zero new
+hooks and the two can never diverge.
+
+Routes (rides on `telemetry.MetricsServer`; ``/metrics`` + ``/healthz``
+come free):
+
+- ``POST /v1/jobs`` — submit: the ``tools jobs submit`` queue-JSON
+  (``{"jobs": [{name, model, nt, grid?, dtype?, priority?, deadline_s?,
+  perturb?, run?}]}`` — ``run`` takes every `RunSpec` knob incl.
+  ``tuned``), or one bare job record. Every record is validated
+  through `service.jobspec_from_json` BEFORE any is enqueued (400 on
+  the first bad one; 409 on a name the service already knows), then
+  all are enqueued: 202.
+- ``GET /v1/jobs`` / ``GET /v1/jobs/<name>`` — journal-derived state
+  and progress, merged with not-yet-claimed queue records (state
+  ``"pending"``).
+- ``POST /v1/jobs/<name>/cancel`` — a still-pending record is atomically
+  discarded before any scheduler claims it; otherwise the control file
+  (404 unknown name, 409 already terminal).
+- ``POST /v1/jobs/<name>/resize`` — body ``{"new_dims": [dx,dy,dz],
+  "via"?: "auto"|"device"|"checkpoint"}`` -> the resize control file.
+- ``POST /v1/drain`` — the global drain request.
+
+SECURITY: inherits `MetricsServer`'s loopback-by-default bind; the
+surface is unauthenticated by design — front it with an authenticating
+proxy before exposing it (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..service.backend import DirectoryBackend, QueueBackend
+from ..service.job import jobspec_from_json
+from ..service.report import is_service_dir, service_report
+from ..telemetry.server import MetricsServer
+from ..utils.exceptions import InvalidArgumentError
+
+__all__ = ["JobApiServer"]
+
+_TERMINAL_STATES = ("done", "failed", "cancelled", "rejected")
+
+
+class JobApiServer:
+    """Serve the job API over one scheduler ``flight_dir`` (see module
+    docstring). ``backend`` defaults to the `DirectoryBackend` over
+    that directory — pass the shared backend instance when schedulers
+    use a custom one. ``port=0`` binds an ephemeral port — read
+    ``.port``. Context manager; `close()` stops the server (the queue
+    and any live scheduler are untouched — the API is stateless)."""
+
+    def __init__(self, flight_dir, port: int = 0, *,
+                 host: str = "127.0.0.1", backend: QueueBackend | None = None,
+                 registry=None):
+        self.flight_dir = os.fspath(flight_dir)
+        os.makedirs(self.flight_dir, exist_ok=True)
+        if backend is not None and not isinstance(backend, QueueBackend):
+            raise InvalidArgumentError(
+                f"backend must be a service.QueueBackend; got "
+                f"{type(backend).__name__}.")
+        self.backend = (backend if backend is not None
+                        else DirectoryBackend(self.flight_dir))
+        self._server = MetricsServer(port, host=host, registry=registry,
+                                     routes=self._route)
+        self.host = self._server.host
+        self.port = self._server.port
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self._server.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- journal view ------------------------------------------------------
+
+    def _journal_jobs(self) -> dict:
+        if not is_service_dir(self.flight_dir):
+            return {}
+        return service_report(self.flight_dir, include_jobs=False)["jobs"]
+
+    def _jobs_view(self) -> dict:
+        jobs = self._journal_jobs()
+        for name in self.backend.pending():
+            if name not in jobs:
+                # enqueued, no scheduler has claimed it yet
+                jobs[name] = {"name": name, "state": "pending"}
+        return jobs
+
+    # -- routing -----------------------------------------------------------
+
+    @staticmethod
+    def _json(code: int, rec: dict):
+        return code, json.dumps(rec, default=str).encode(), \
+            "application/json"
+
+    def _route(self, method: str, path: str, query: str, body: bytes):
+        if path == "/v1/drain" and method == "POST":
+            self.backend.control("drain")
+            return self._json(202, {"requested": "drain"})
+        if path in ("/v1/jobs", "/v1/jobs/"):
+            if method == "POST":
+                return self._submit(body)
+            return self._json(200, {"jobs": self._jobs_view()})
+        prefix = "/v1/jobs/"
+        if not path.startswith(prefix):
+            return None
+        rest = path[len(prefix):].split("/")
+        if method == "GET" and len(rest) == 1 and rest[0]:
+            job = self._jobs_view().get(rest[0])
+            if job is None:
+                return self._json(
+                    404, {"error": f"no job named {rest[0]!r}",
+                          "have": sorted(self._jobs_view())})
+            return self._json(200, job)
+        if method == "POST" and len(rest) == 2 and rest[0] \
+                and rest[1] in ("cancel", "resize"):
+            try:
+                return self._control(rest[0], rest[1], body)
+            except InvalidArgumentError as e:
+                return self._json(400, {"error": str(e)})
+        return None
+
+    def _submit(self, body: bytes):
+        try:
+            doc = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            return self._json(400, {"error": f"request body is not "
+                                             f"JSON: {e}"})
+        if isinstance(doc, dict) and "jobs" in doc:
+            records = doc["jobs"]
+        elif isinstance(doc, dict):
+            records = [doc]  # one bare job record
+        else:
+            records = None
+        if not isinstance(records, list) or not records:
+            return self._json(
+                400, {"error": "expected {'jobs': [...]} (the tools "
+                               "jobs submit queue-JSON) or one job "
+                               "record object."})
+        # validate EVERYTHING before enqueueing ANYTHING — a bad record
+        # in a batch must not half-submit it
+        known = set(self._jobs_view())
+        names = []
+        for i, rec in enumerate(records):
+            try:
+                spec = jobspec_from_json(rec,
+                                         where=f"POST /v1/jobs job #{i}")
+            except InvalidArgumentError as e:
+                return self._json(400, {"error": str(e)})
+            if spec.name in known or spec.name in names:
+                return self._json(
+                    409, {"error": f"a job named {spec.name!r} already "
+                                   "exists on this service (names key "
+                                   "journals and queue records)."})
+            names.append(spec.name)
+        for rec in records:
+            self.backend.submit(dict(rec))
+        return self._json(202, {"submitted": names})
+
+    def _control(self, name: str, verb: str, body: bytes):
+        if verb == "cancel" and self.backend.discard(name):
+            # atomically beat every scheduler to the pending record —
+            # the job never existed as far as any journal is concerned
+            return self._json(202, {"requested": "cancel", "job": name,
+                                    "discarded": True})
+        job = self._jobs_view().get(name)
+        if job is None:
+            return self._json(404, {"error": f"no job named {name!r}",
+                                    "have": sorted(self._jobs_view())})
+        if job["state"] in _TERMINAL_STATES:
+            return self._json(409, {"error": f"job {name!r} already "
+                                             f"{job['state']}"})
+        if verb == "cancel":
+            self.backend.control("cancel", name)
+            return self._json(202, {"requested": "cancel", "job": name})
+        # resize
+        try:
+            req = json.loads(body.decode("utf-8")) if body else {}
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            return self._json(400, {"error": f"resize body is not "
+                                             f"JSON: {e}"})
+        if not isinstance(req, dict):
+            return self._json(400, {"error": "resize body must be "
+                                             "{'new_dims': [dx,dy,dz], "
+                                             "'via'?: ...}"})
+        dims = req.get("new_dims")
+        try:
+            dims = [int(x) for x in (dims or ())]
+        except (TypeError, ValueError):
+            dims = []
+        via = req.get("via", "auto")
+        if len(dims) != 3 or any(d < 1 for d in dims):
+            return self._json(400, {"error": "new_dims must be 3 "
+                                             f"positive ints; got "
+                                             f"{req.get('new_dims')!r}"})
+        if via not in ("auto", "device", "checkpoint"):
+            return self._json(400, {"error": f"via must be auto|device|"
+                                             f"checkpoint; got {via!r}"})
+        self.backend.control("resize", name,
+                             {"new_dims": dims, "via": via})
+        return self._json(202, {"requested": "resize", "job": name,
+                                "new_dims": dims, "via": via})

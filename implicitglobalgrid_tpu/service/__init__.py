@@ -11,9 +11,22 @@ JSONL; the scheduler owns the long-lived /metrics + /healthz endpoint
 with per-job labeled gauges. `service_report`/`export_service_trace`
 reconstruct the interleaved schedule post-hoc (one Perfetto track per
 job); `tools jobs submit|list|status|cancel|drain` is the operator CLI.
+
+Producers outside the scheduler process reach it through a
+`QueueBackend` (`DirectoryBackend` = queue-JSON records + the control-
+file protocol under one directory, atomic-rename claims so N schedulers
+partition jobs without double-admission); `jobspec_from_json` is the one
+record-to-`JobSpec` code path the CLI (`tools jobs submit`) and the HTTP
+front door (`serve.JobApiServer`) share. Jobs with a ``deadline_s`` are
+priced at admission (`telemetry.predict_step`) and REJECTED when their
+completion provably busts the budget.
 """
 
-from .job import BUILTIN_MODELS, Job, JobSpec, JobState, builtin_setup
+from .backend import DirectoryBackend, QueueBackend
+from .job import (
+    BUILTIN_MODELS, Job, JobSpec, JobState, builtin_setup,
+    jobspec_from_json,
+)
 from .policies import (
     FairSharePolicy, FifoPolicy, POLICIES, RoundRobinPolicy,
     SchedulingPolicy, resolve_policy,
@@ -24,6 +37,8 @@ from .scheduler import MeshScheduler
 __all__ = [
     "MeshScheduler",
     "JobSpec", "Job", "JobState", "builtin_setup", "BUILTIN_MODELS",
+    "jobspec_from_json",
+    "QueueBackend", "DirectoryBackend",
     "SchedulingPolicy", "FifoPolicy", "RoundRobinPolicy",
     "FairSharePolicy", "POLICIES", "resolve_policy",
     "service_report", "export_service_trace", "is_service_dir",

@@ -23,7 +23,8 @@ from typing import Any, Callable
 from ..runtime.spec import RunSpec
 from ..utils.exceptions import InvalidArgumentError
 
-__all__ = ["JobSpec", "Job", "JobState", "builtin_setup", "BUILTIN_MODELS"]
+__all__ = ["JobSpec", "Job", "JobState", "builtin_setup", "BUILTIN_MODELS",
+           "jobspec_from_json"]
 
 
 class JobState:
@@ -35,8 +36,9 @@ class JobState:
     DONE = "done"            # completed all nt steps; result available
     FAILED = "failed"        # raised (retry budget, fatal guard, setup)
     CANCELLED = "cancelled"  # cancelled before completion
+    REJECTED = "rejected"    # refused at admission (deadline pricing)
 
-    TERMINAL = (DONE, FAILED, CANCELLED)
+    TERMINAL = (DONE, FAILED, CANCELLED, REJECTED)
 
 
 @dataclass(frozen=True)
@@ -53,9 +55,19 @@ class JobSpec:
     ``run`` is the embedded `runtime.RunSpec` (all ~20 supervised-run
     knobs — not re-declared here). ``priority`` is the weight the
     ``fair`` policy shares mesh time by (higher = more slices; must be
-    >= 1); ``deadline_s`` is advisory metadata (journaled, reported, and
-    exported so an operator can alert on it — no policy enforces it
-    yet)."""
+    >= 1).
+
+    ``deadline_s`` is a wall-clock budget measured from submission.
+    Two mechanisms enforce it: admission pricing — when ``model`` names
+    a `telemetry.predict_step` workload (``diffusion3d`` …, what
+    `jobspec_from_json` fills for built-in jobs), the scheduler prices
+    the job's expected mesh-seconds at ``_admit`` time and REJECTS a
+    job whose priced completion provably busts the remaining budget
+    (journaled ``admission_priced`` verdict; `JobState.REJECTED`) —
+    and the runtime ``deadline_missed`` flight event + counter when a
+    running job crosses it anyway. ``model=None`` (a custom setup) is
+    unpriceable: such jobs always admit; only the runtime surface
+    fires."""
 
     name: str
     setup: Callable[[], tuple]
@@ -64,6 +76,7 @@ class JobSpec:
     run: RunSpec = field(default_factory=RunSpec)
     priority: int = 1
     deadline_s: float | None = None
+    model: str | None = None
 
     def __post_init__(self):
         if not self.name or "/" in str(self.name):
@@ -84,6 +97,10 @@ class JobSpec:
             raise InvalidArgumentError(
                 f"JobSpec.priority is a fair-share weight >= 1; got "
                 f"{self.priority}.")
+        if self.deadline_s is not None and not float(self.deadline_s) > 0:
+            raise InvalidArgumentError(
+                f"JobSpec.deadline_s is a wall-clock budget in seconds "
+                f"(> 0) measured from submission; got {self.deadline_s}.")
 
 
 class Job:
@@ -110,6 +127,7 @@ class Job:
         self.cancel_requested = False
         self.resize_requested = None    # (dims tuple, via); applied at a slice
         self.last_end_t: float | None = None
+        self.deadline_logged = False    # deadline_missed journaled once
 
     @property
     def name(self) -> str:
@@ -308,3 +326,62 @@ def builtin_setup(model: str, dtype: str = "float32",
            if cfg is not None else "")
         + ")")
     return setup
+
+
+def jobspec_from_json(rec: dict, *, where: str = "job record") -> JobSpec:
+    """Build a `JobSpec` from one queue-JSON job record — THE schema of
+    ``tools jobs submit`` and ``POST /v1/jobs`` (one code path, so the
+    CLI and the HTTP API can never diverge):
+
+        {"name": ..., "model": ..., "nt": ...,         # required
+         "grid": {...}, "dtype": "float32",            # optional
+         "priority": 1, "deadline_s": ..., "perturb": 0.0,
+         "run": {... RunSpec knobs, incl. "tuned"/"ensemble" ...}}
+
+    ``where`` labels errors (a file path, an HTTP request id). Unknown
+    top-level keys and unknown ``run`` knobs raise `InvalidArgumentError`
+    loudly — a typo'd knob must fail, not silently default."""
+    if not isinstance(rec, dict):
+        raise InvalidArgumentError(
+            f"{where}: a job record must be a JSON object; got "
+            f"{type(rec).__name__}.")
+    rec = dict(rec)
+    missing = [k for k in ("name", "model", "nt") if k not in rec]
+    if missing:
+        raise InvalidArgumentError(
+            f"{where}: missing required key(s) {missing}.")
+    run = dict(rec.pop("run", {}) or {})
+    # runner caching across chunks needs a key; the job name is the
+    # natural one
+    run.setdefault("key", ("jobs_cli", rec.get("name")))
+    model = rec.pop("model")
+    try:
+        # a batched job is JSON-describable end-to-end: the RunSpec's
+        # ensemble knob also drives the setup's member stacking
+        # ("perturb" ramps the members into parameter variants), and a
+        # "tuned" path applies the auto-tuner's knob set on both sides —
+        # the setup (structural: comm_every/overlap/ensemble) and the
+        # driver (trace-time: wire/coalesce env)
+        spec = JobSpec(
+            name=rec.pop("name"),
+            setup=builtin_setup(model,
+                                rec.pop("dtype", "float32"),
+                                ensemble=run.get("ensemble"),
+                                perturb=rec.pop("perturb", 0.0),
+                                tuned=run.get("tuned")),
+            nt=rec.pop("nt"),
+            grid=dict(rec.pop("grid", {}) or {}),
+            run=RunSpec(**run),
+            priority=rec.pop("priority", 1),
+            deadline_s=rec.pop("deadline_s", None),
+            model=model)
+    except TypeError as e:
+        # RunSpec(**run) with an unknown knob — surface it as the typed
+        # validation error every caller (CLI exit, HTTP 400) handles
+        raise InvalidArgumentError(
+            f"{where}: bad 'run' knob set ({e}).") from e
+    if rec:  # a typo'd knob must fail, not silently default
+        raise InvalidArgumentError(
+            f"{where}: job {spec.name!r} has unknown key(s) "
+            f"{sorted(rec)} (supervised-run knobs belong inside 'run').")
+    return spec

@@ -34,7 +34,8 @@ __all__ = ["service_report", "export_service_trace", "read_journal"]
 
 _JOURNAL = "scheduler.jsonl"
 _TERMINAL_KINDS = {"job_done": "done", "job_failed": "failed",
-                   "job_cancelled": "cancelled"}
+                   "job_cancelled": "cancelled",
+                   "job_rejected": "rejected"}
 
 
 def journal_path(flight_dir) -> str:
@@ -93,6 +94,7 @@ def service_report(source, *, include_jobs: bool = True) -> dict:
         return jobs[name]
 
     schedule: list = []
+    submit_rejected: list = []
     switches = 0
     prev_job = None
     queued = running = 0
@@ -112,6 +114,23 @@ def service_report(source, *, include_jobs: bool = True) -> dict:
             r["state"] = "running"
             queued -= 1
             running += 1
+        elif k == "job_claimed":
+            # the record arrived through a queue backend (CLI drop /
+            # HTTP POST) — who claimed it, for multi-scheduler forensics
+            rec(e["job"])["claimed_by"] = e.get("owner")
+        elif k == "admission_priced":
+            # the deadline-admission verdict WITH its pricing inputs —
+            # the journal defends every reject (and every admit)
+            rec(e["job"])["admission"] = {
+                key: v for key, v in e.items()
+                if key not in ("kind", "t", "run", "job")}
+        elif k == "deadline_missed":
+            r = rec(e["job"])
+            r["deadline_missed"] = {"step": e.get("step"),
+                                    "deadline_s": e.get("deadline_s")}
+        elif k == "submit_rejected":
+            submit_rejected.append({"job": e.get("job"),
+                                    "error": e.get("error")})
         elif k == "slice":
             r = rec(e["job"])
             r["slices"] += 1
@@ -168,6 +187,8 @@ def service_report(source, *, include_jobs: bool = True) -> dict:
         "jobs": {name: jobs[name] for name in order},
         "schedule": schedule,
     }
+    if submit_rejected:
+        report["submit_rejected"] = submit_rejected
     if stop is not None:
         report["closed"] = True
     if include_jobs:
@@ -247,7 +268,8 @@ def export_service_trace(source, out=None):
             queued -= 1
             trace.append({"ph": "C", "pid": 0, "name": "igg_jobs_queued",
                           "ts": us(t), "args": {"jobs": queued}})
-        elif k in ("job_done", "job_failed", "job_cancelled", "drain",
+        elif k in ("job_done", "job_failed", "job_cancelled",
+                   "job_rejected", "deadline_missed", "drain",
                    "scheduler_start", "scheduler_stop", "control"):
             if k in _TERMINAL_KINDS and e.get("job") not in admitted:
                 # cancelled (or admission-failed) while still QUEUED:

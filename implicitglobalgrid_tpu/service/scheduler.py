@@ -52,10 +52,24 @@ from ..runtime.driver import ResilientRun
 from ..telemetry import hooks
 from ..telemetry.recorder import FlightRecorder, use_flight_recorder
 from ..utils.exceptions import InvalidArgumentError
-from .job import Job, JobSpec, JobState
+from .backend import DirectoryBackend, QueueBackend
+from .job import Job, JobSpec, JobState, jobspec_from_json
 from .policies import resolve_policy
 
 __all__ = ["MeshScheduler"]
+
+
+class _DeadlineRejected(Exception):
+    """Internal control flow: `_admit`'s deadline pricing refused the
+    job. Carries the journaled verdict record; `_slice` turns it into
+    `JobState.REJECTED` (a verdict, not a failure)."""
+
+    def __init__(self, verdict: dict):
+        super().__init__(
+            f"admission rejected: priced {verdict['admit_price_s']:.3g}s "
+            f"of mesh time > {verdict['budget_s']:.3g}s left of "
+            f"deadline_s={verdict['deadline_s']:.6g}")
+        self.verdict = verdict
 
 
 def _evict_epoch_caches(epoch: int) -> None:
@@ -96,7 +110,8 @@ class MeshScheduler:
 
     def __init__(self, *, policy="fifo", flight_dir=None,
                  metrics_port: int | None = None,
-                 healthz_max_age_s: float | None = None):
+                 healthz_max_age_s: float | None = None,
+                 queue: QueueBackend | None = None):
         self.policy = resolve_policy(policy)
         self.flight_dir = None if flight_dir is None else str(flight_dir)
         self.jobs: dict = {}
@@ -117,6 +132,20 @@ class MeshScheduler:
             self._journal = FlightRecorder(
                 os.path.join(self.flight_dir, "scheduler.jsonl"),
                 run_id="scheduler")
+        # the queue backend: where out-of-process producers (the CLI,
+        # serve.JobApiServer, a peer scheduler's overflow) enqueue job
+        # records and file control requests. A flight_dir implies the
+        # directory backend over it — the PR-8 control-file protocol,
+        # verbatim — so existing deployments change nothing; an explicit
+        # backend can be SHARED between schedulers (atomic-rename claims
+        # partition the jobs, zero double-admissions).
+        if queue is not None and not isinstance(queue, QueueBackend):
+            raise InvalidArgumentError(
+                f"queue must be a service.QueueBackend; got "
+                f"{type(queue).__name__}.")
+        self.queue = queue
+        if queue is None and self.flight_dir is not None:
+            self.queue = DirectoryBackend(self.flight_dir)
         try:
             if metrics_port is not None:
                 from ..telemetry.server import start_metrics_server
@@ -136,7 +165,9 @@ class MeshScheduler:
         self._log("scheduler_start", policy=self.policy.name,
                   wall=time.time(),
                   metrics_port=None if self._server is None
-                  else self._server.port)
+                  else self._server.port,
+                  queue_owner=None if self.queue is None
+                  else getattr(self.queue, "owner", None))
 
     @staticmethod
     def _audit_total() -> float:
@@ -317,6 +348,7 @@ class MeshScheduler:
         drained)."""
         self._check_open()
         self._poll_control()
+        self._poll_queue()
         cands = self.runnable()
         for j in [j for j in cands if j.cancel_requested]:
             self._finalize(j, JobState.CANCELLED)
@@ -347,57 +379,74 @@ class MeshScheduler:
             sum(1 for j in self._order if j.state == JobState.RUNNING))
 
     def _poll_control(self) -> None:
-        """CLI control channel: `tools jobs cancel|drain` drop request
-        files under ``<flight_dir>/control/``; a live scheduler consumes
-        them at slice boundaries."""
-        if self.flight_dir is None:
+        """Control channel: `tools jobs cancel|drain|resize` and the
+        HTTP API file request files through the queue backend; a live
+        scheduler consumes them at slice boundaries."""
+        if self.queue is None:
             return
-        ctl = os.path.join(self.flight_dir, "control")
-        if not os.path.isdir(ctl):
-            return
-        for fname in sorted(os.listdir(ctl)):
-            path = os.path.join(ctl, fname)
-            if fname.endswith(".tmp"):
-                continue  # a request still being written (CLI staging)
-            if fname == "drain":
-                os.remove(path)
+        for req in self.queue.poll_control():
+            kind = req["request"]
+            if kind == "drain":
                 self._log("control", request="drain")
                 self.drain()
-            elif fname.startswith("cancel_"):
-                os.remove(path)
-                name = fname[len("cancel_"):]
+            elif kind == "cancel":
+                name = req["job"]
                 self._log("control", request="cancel", job=name)
                 job = self.jobs.get(name)
                 if job is not None and not job.finished:
                     self.cancel(name)
-            elif fname.startswith("resize_"):
-                import json as _json
-
-                name = fname[len("resize_"):]
-                try:
-                    with open(path, encoding="utf-8") as f:
-                        req = _json.load(f)
-                except Exception:
-                    req = None
-                os.remove(path)
+            elif kind == "resize":
+                name, payload = req["job"], req.get("payload")
                 self._log("control", request="resize", job=name,
-                          payload=req)
+                          payload=payload)
                 job = self.jobs.get(name)
-                if job is None or job.finished or not isinstance(req, dict):
+                if job is None or job.finished \
+                        or not isinstance(payload, dict):
                     # never drop an operator request silently
                     self._log("resize_rejected", job=name,
                               error=("malformed control payload"
-                                     if not isinstance(req, dict) else
+                                     if not isinstance(payload, dict) else
                                      "unknown or finished job"))
                     continue
                 try:
-                    self.resize(name, req.get("new_dims", ()),
-                                via=req.get("via", "auto"))
+                    self.resize(name, payload.get("new_dims", ()),
+                                via=payload.get("via", "auto"))
                 except (InvalidArgumentError, ValueError, TypeError) as e:
                     # ValueError/TypeError: non-integer new_dims in a
                     # hand-written control file — an operator typo must
                     # not take the scheduler (and every tenant) down
                     self._log("resize_rejected", job=name, error=str(e))
+
+    def _poll_queue(self) -> None:
+        """Claim at most ONE pending record from the queue backend per
+        scheduling decision — claims interleave with slices, so N
+        schedulers sharing a backend each take work at the rate they
+        can serve it (and the atomic-rename claim guarantees every
+        record is admitted by exactly one of them)."""
+        if self.queue is None or self._draining:
+            return
+        claimed = self.queue.claim()
+        if claimed is None:
+            return
+        name = claimed["name"]
+        if claimed.get("record") is None:
+            self._log("submit_rejected", job=name,
+                      error=claimed.get("error") or "unreadable record")
+            return
+        self._log("job_claimed", job=name,
+                  owner=getattr(self.queue, "owner", None))
+        try:
+            spec = jobspec_from_json(claimed["record"],
+                                     where=f"queue record {name!r}")
+            if spec.name != name:
+                raise InvalidArgumentError(
+                    f"queue record {name!r} names job {spec.name!r} — "
+                    "the record key and its 'name' must agree.")
+            self.submit(spec)
+        except InvalidArgumentError as e:
+            # a malformed record must not take the scheduler (and every
+            # tenant) down — journal the rejection and keep serving
+            self._log("submit_rejected", job=name, error=str(e))
 
     def _admit(self, job: Job) -> None:
         """First slice grant: build the job's grid over the shared device
@@ -444,6 +493,17 @@ class MeshScheduler:
             top.retain_epoch(job.gg.epoch)
             with use_flight_recorder(job.recorder), knob_scope:
                 step_local, state = job.spec.setup()
+                self._price_admission(job, run_spec, tuned, state)
+                if job.spec.deadline_s is not None \
+                        and run_spec.deadline_s is None:
+                    # hand the REMAINING budget to the runtime surface:
+                    # the driver fires deadline_missed (event + counter)
+                    # when an admitted job crosses it anyway
+                    left = float(job.spec.deadline_s) - max(
+                        0.0, time.time() - (job.submitted_t
+                                            or time.time()))
+                    run_spec = dataclasses.replace(
+                        run_spec, deadline_s=max(1e-9, left))
                 job.run = ResilientRun(step_local, state,
                                        int(job.spec.nt), run_spec)
         except BaseException:
@@ -463,6 +523,85 @@ class MeshScheduler:
                       **tuned.knobs(), speedup=tuned.speedup)
         self._log("job_admitted", job=job.name, admit_s=job.admit_s,
                   epoch=int(job.gg.epoch))
+
+    def _price_admission(self, job: Job, run_spec, tuned, state) -> None:
+        """Deadline-aware admission (runs under the job's grid, state
+        built): price the job's expected mesh-seconds with the PR-6
+        cost model — ``predict_step`` on the job's OWN field shapes,
+        honoring its tuned knob set and ensemble width — and refuse a
+        job whose priced completion provably busts what is left of its
+        ``deadline_s`` budget. Every verdict (admit AND reject) is
+        journaled as ``admission_priced`` with the full pricing inputs,
+        so `service_report` can defend it post-hoc. Unpriceable jobs
+        (no ``model``, a non-workload model, a cost-model refusal)
+        always admit — admission only rejects what it can PROVE."""
+        spec = job.spec
+        if spec.deadline_s is None:
+            return
+        from ..telemetry.perfmodel import (
+            STEP_WORKLOADS, default_machine_profile, predict_step,
+        )
+
+        waited_s = max(0.0, time.time() - (job.submitted_t
+                                           or time.time()))
+        budget_s = float(spec.deadline_s) - waited_s
+        if spec.model not in STEP_WORKLOADS:
+            self._log("admission_priced", job=job.name, verdict="admit",
+                      priced_by="unpriceable", model=spec.model,
+                      deadline_s=float(spec.deadline_s),
+                      waited_s=waited_s, budget_s=budget_s)
+            return
+        from ..models.common import resolve_comm_every
+
+        E = run_spec.ensemble
+        # per-member stacked shapes in canonical state order (the
+        # builtin setups build the dict in exactly that order); an
+        # ensemble state carries members on a leading axis predict_step
+        # must not read as geometry
+        import jax
+
+        fields = tuple(
+            jax.ShapeDtypeStruct(v.shape[1:] if E else v.shape, v.dtype)
+            for v in state.values())
+        knobs = dict(comm_every=1, overlap=False, coalesce=None,
+                     wire_dtype=None, wire_stage=None)
+        if tuned is not None:
+            knobs = dict(comm_every=tuned.comm_every,
+                         overlap=bool(tuned.overlap),
+                         coalesce=tuned.coalesce,
+                         wire_dtype=tuned.wire_dtype,
+                         wire_stage=tuned.wire_stage)
+        try:
+            pred = predict_step(spec.model, fields,
+                                profile=default_machine_profile(),
+                                ensemble=E, **knobs)
+        except Exception as e:
+            # the cost model refusing a geometry is not a admission
+            # failure — an unpriceable job admits (and says why)
+            self._log("admission_priced", job=job.name, verdict="admit",
+                      priced_by="unpriceable", model=spec.model,
+                      error=f"{type(e).__name__}: {e}",
+                      deadline_s=float(spec.deadline_s),
+                      waited_s=waited_s, budget_s=budget_s)
+            return
+        cadence = resolve_comm_every(knobs["comm_every"])
+        # a deep cadence makes the job's step the SUPER-STEP (the
+        # builtin setups' rule): one nt unit = cadence.cycle physical
+        # steps, each priced at step_s
+        steps_per_unit = cadence.cycle if cadence.deep else 1
+        price_s = pred["step_s"] * steps_per_unit * int(spec.nt)
+        verdict = "admit" if price_s <= budget_s else "reject"
+        rec = dict(job=job.name, verdict=verdict,
+                   admit_price_s=price_s, step_price_s=pred["step_s"],
+                   nt=int(spec.nt), steps_per_unit=steps_per_unit,
+                   deadline_s=float(spec.deadline_s), waited_s=waited_s,
+                   budget_s=budget_s, bound=pred.get("bound"),
+                   profile_source=pred.get("profile_source"),
+                   model=spec.model, ensemble=E,
+                   priced_by="predict_step")
+        self._log("admission_priced", **rec)
+        if verdict == "reject":
+            raise _DeadlineRejected(rec)
 
     def _slice(self, job: Job) -> None:
         """Grant ``job`` one chunk-boundary slice (admitting it first if
@@ -517,12 +656,29 @@ class MeshScheduler:
                     _evict_epoch_caches(old.epoch)
             finally:
                 top.swap_global_grid(prev)
+        except _DeadlineRejected as e:
+            # an admission verdict, not a failure: the job never ran
+            job.error = str(e)
+            self._account_slice(job, t_pick, wait_s, chunks0)
+            self._finalize(job, JobState.REJECTED)
+            return
         except Exception as e:
             job.error = f"{type(e).__name__}: {e}"
             self._account_slice(job, t_pick, wait_s, chunks0)
             self._finalize(job, JobState.FAILED)
             return
         self._account_slice(job, t_pick, wait_s, chunks0)
+        # a running job crossing its deadline (the driver flagged it at
+        # a chunk boundary): journal it ONCE — the admission verdict
+        # said yes, the operator deserves to see where it went wrong
+        if job.run is not None \
+                and getattr(job.run, "deadline_missed", False) \
+                and not job.deadline_logged:
+            job.deadline_logged = True
+            # the budget the driver actually watched (run-level, which
+            # _admit derives from the job deadline when unset)
+            self._log("deadline_missed", job=job.name, step=job.step,
+                      deadline_s=job.run.deadline_s)
         # re-tune trigger (ROADMAP tuner rung c): a resize or PerfWatch
         # drift marked the applied TunedConfig stale — the scheduler
         # reacts at the slice boundary by clearing it (journaled; the

@@ -59,6 +59,7 @@ JOB_PERF_RATIO = "igg_job_perf_model_ratio"
 JOB_AUDIT_FINDINGS = "igg_job_audit_findings_total"
 JOB_SLICE_SECONDS = "igg_job_slice_seconds"
 JOB_WAIT_SECONDS = "igg_job_wait_seconds"
+DEADLINE_MISSED = "igg_job_deadline_missed_total"
 # ensemble axis (ISSUE 12): per-member guard verdicts as labeled series
 # (the igg_job_* twins are the scheduler's per-tenant scoped mirrors —
 # distinct family names because a ScopedRegistry view adds the job label
@@ -324,6 +325,16 @@ def note_job_transition(state: str) -> None:
     metrics_registry().counter(
         JOBS_TOTAL, "Job lifecycle transitions by terminal state.",
         ("state",)).inc(1, state=state)
+
+
+def note_deadline_missed() -> None:
+    """Count one run crossing its ``deadline_s`` budget (the driver
+    fires it at most once per run, with the ``deadline_missed`` flight
+    event — the alertable twin of the journal record)."""
+    metrics_registry().counter(
+        DEADLINE_MISSED,
+        "Runs that crossed their deadline_s budget while running."
+        ).inc(1)
 
 
 def job_gauges(registry, job: str):

@@ -45,25 +45,63 @@ class MetricsServer:
     """The running endpoint. ``port=0`` picks a free port (read ``.port``
     after construction — the pattern tests and parallel launchers use).
     Use as a context manager or call `close()`; the server thread is a
-    daemon either way, so a crashed run never hangs on it."""
+    daemon either way, so a crashed run never hangs on it.
+
+    ``routes`` extends the surface beyond /metrics + /healthz (the
+    serving tier's job API and snapshot query service ride on exactly
+    this server): a callable ``(method, path, query, body) ->
+    (code, body_bytes, ctype[, headers_dict]) | None`` — ``query`` is
+    the RAW query string, ``body`` the request bytes (b"" for GET);
+    return None to 404. Route exceptions answer a JSON 500 (the server
+    thread must survive any handler bug)."""
 
     def __init__(self, port: int = 0, *, host: str = "127.0.0.1",
-                 registry=None, healthz_max_age_s: float | None = None):
+                 registry=None, healthz_max_age_s: float | None = None,
+                 routes=None):
         reg = registry if registry is not None else metrics_registry()
         max_age = None if healthz_max_age_s is None \
             else float(healthz_max_age_s)
+        if routes is not None and not callable(routes):
+            raise InvalidArgumentError(
+                "MetricsServer routes must be callable "
+                "(method, path, query, body) -> response tuple or None.")
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # no stderr chatter per scrape
                 pass
 
-            def _send(self, code: int, body: bytes, ctype: str) -> None:
+            def _send(self, code: int, body: bytes, ctype: str,
+                      headers: dict | None = None) -> None:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _route(self, method: str, body: bytes) -> None:
+                path, _, query = self.path.partition("?")
+                if routes is None:
+                    self._send(404, b"not found\n", "text/plain")
+                    return
+                try:
+                    resp = routes(method, path, query, body)
+                except Exception as e:
+                    # a handler bug answers 500; the thread survives
+                    self._send(500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode(),
+                        "application/json")
+                    return
+                if resp is None:
+                    self._send(404, json.dumps(
+                        {"error": f"no route for {method} {path}"}
+                        ).encode(), "application/json")
+                    return
+                code, payload, ctype = resp[0], resp[1], resp[2]
+                headers = resp[3] if len(resp) > 3 else None
+                self._send(int(code), payload, ctype, headers)
 
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
@@ -76,7 +114,15 @@ class MetricsServer:
                     self._send(code, json.dumps(rec).encode(),
                                "application/json")
                 else:
-                    self._send(404, b"not found\n", "text/plain")
+                    self._route("GET", b"")
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    n = 0
+                body = self.rfile.read(n) if n > 0 else b""
+                self._route("POST", body)
 
         self.registry = reg
         self.healthz_max_age_s = max_age

@@ -998,9 +998,7 @@ def _cli_jobs(args) -> int:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-        from .runtime.spec import RunSpec
-        from .service import JobSpec, JobState, MeshScheduler, \
-            builtin_setup
+        from .service import JobState, MeshScheduler, jobspec_from_json
 
         with open(args.spec, encoding="utf-8") as f:
             queue = json.load(f)
@@ -1013,42 +1011,11 @@ def _cli_jobs(args) -> int:
                               metrics_port=args.metrics_port)
         try:
             for i, rec in enumerate(queue["jobs"]):
-                rec = dict(rec)
-                missing = [k for k in ("name", "model", "nt")
-                           if k not in rec]
-                if missing:
-                    raise InvalidArgumentError(
-                        f"{args.spec}: job #{i} is missing required "
-                        f"key(s) {missing}.")
-                run = dict(rec.pop("run", {}) or {})
-                # runner caching across chunks needs a key; the job name
-                # is the natural one
-                run.setdefault("key", ("jobs_cli", rec.get("name")))
-                spec = JobSpec(
-                    name=rec.pop("name"),
-                    # a batched job is JSON-describable end-to-end: the
-                    # RunSpec's ensemble knob also drives the setup's
-                    # member stacking ("perturb" ramps the members into
-                    # parameter variants), and a "tuned" path applies
-                    # the auto-tuner's knob set on both sides — the
-                    # setup (structural: comm_every/overlap/ensemble)
-                    # and the driver (trace-time: wire/coalesce env)
-                    setup=builtin_setup(rec.pop("model"),
-                                        rec.pop("dtype", "float32"),
-                                        ensemble=run.get("ensemble"),
-                                        perturb=rec.pop("perturb", 0.0),
-                                        tuned=run.get("tuned")),
-                    nt=rec.pop("nt"),
-                    grid=dict(rec.pop("grid", {}) or {}),
-                    run=RunSpec(**run),
-                    priority=rec.pop("priority", 1),
-                    deadline_s=rec.pop("deadline_s", None))
-                if rec:  # a typo'd knob must fail, not silently default
-                    raise InvalidArgumentError(
-                        f"{args.spec}: job {spec.name!r} has unknown "
-                        f"key(s) {sorted(rec)} (supervised-run knobs "
-                        "belong inside 'run').")
-                sched.submit(spec)
+                # one schema, one code path with POST /v1/jobs
+                # (service.jobspec_from_json) — the CLI and the HTTP
+                # API can never diverge
+                sched.submit(jobspec_from_json(
+                    rec, where=f"{args.spec}: job #{i}"))
             sched.run()
             status = sched.status()
         finally:

@@ -164,9 +164,21 @@ def load_prefixed_meta(dirpath) -> dict:
         raise InvalidArgumentError(
             f"Sharded checkpoint meta not found: {meta_path}")
     verify_checksum(meta_path, required=False)
-    with np.load(meta_path) as z:
-        return {k[len(META_PREFIX):]: z[k] for k in z.files
-                if k.startswith(META_PREFIX)}
+    import zipfile
+
+    try:
+        with np.load(meta_path) as z:
+            return {k[len(META_PREFIX):]: z[k] for k in z.files
+                    if k.startswith(META_PREFIX)}
+    except (OSError, ValueError, zipfile.BadZipFile) as e:
+        # a half-committed / truncated meta.npz without a sidecar (a
+        # pre-checksum save interrupted mid-copy) surfaces as a raw
+        # zipfile error — readers polling a live root need the TYPED
+        # refusal instead
+        raise IncoherentArgumentError(
+            f"{meta_path} is unreadable ({type(e).__name__}: {e}) — the "
+            "directory is half-committed or was truncated after commit; "
+            "do not read from it.") from e
 
 
 def commit_staged_dir(stage: str, final: str, token: str) -> None:

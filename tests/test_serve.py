@@ -1,0 +1,589 @@
+"""Serving-tier tests (ISSUE 17): networked job API, read-side snapshot
+query service, deadline-aware admission, pluggable queue backend.
+
+The acceptance bar, tier-1: a job submitted over HTTP runs to
+completion under a live `MeshScheduler` and ends bit-identical to its
+CLI-submitted twin while cancel and resize arrive over HTTP; a
+committed snapshot answers a sub-box HTTP query byte-identical to
+`Snapshot.read_global` with the block LRU hitting on the second read;
+an over-deadline job is REJECTED at admission with a journaled
+`predict_step`-priced verdict `service_report` reproduces; and two
+schedulers sharing one backend admit ≥20 jobs with zero
+double-admissions (atomic-rename claim).
+
+Budget note (ROADMAP tier-1): one fast representative per behavior;
+the 20-job partition runs backend-only (no mesh); matrices ride `slow`.
+"""
+
+import io
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.serve import (
+    BlockCache, CachedSnapshot, JobApiServer, SnapshotQueryServer,
+)
+from implicitglobalgrid_tpu.service import (
+    DirectoryBackend, JobState, MeshScheduler, QueueBackend,
+    jobspec_from_json,
+)
+from implicitglobalgrid_tpu.utils.exceptions import (
+    IncoherentArgumentError, InvalidArgumentError,
+)
+
+from conftest import (
+    health_counters_from_registry as _health_counters,
+    reset_health_counters_in_registry as _reset_health_counters,
+)
+
+GRID_A = dict(nx=6, ny=6, nz=6, dimx=2, dimy=2, dimz=1)
+
+
+def _record(name, nt=8, nt_chunk=4, **extra):
+    """One queue-JSON job record — THE schema of `tools jobs submit`
+    and ``POST /v1/jobs`` (float64: the tier-1 x64 default, so interiors
+    compare bit-exactly)."""
+    rec = {"name": name, "model": "diffusion3d", "nt": nt,
+           "grid": dict(GRID_A), "dtype": "float64",
+           "run": {"nt_chunk": nt_chunk}}
+    rec.update(extra)
+    return rec
+
+
+def _interior(sched, name):
+    """Gathered interior of a finished job's result, under ITS grid."""
+    from implicitglobalgrid_tpu.parallel import topology as top
+
+    job = sched.job(name)
+    prev = top.swap_global_grid(job.gg)
+    try:
+        return igg.gather_interior(job.result["T"])
+    finally:
+        top.swap_global_grid(prev)
+
+
+_TWIN_CACHE: dict = {}
+
+
+def _twin_interior(tmp_path, nt=8, nt_chunk=4):
+    """The CLI-submitted twin: the same queue record pushed through
+    `jobspec_from_json` + a solo scheduler (exactly the `tools jobs
+    submit` code path). Memoized — several tenants compare against one
+    reference."""
+    key = (nt, nt_chunk)
+    if key in _TWIN_CACHE:
+        return _TWIN_CACHE[key]
+    with MeshScheduler(flight_dir=str(tmp_path / "twin")) as sched:
+        sched.submit(jobspec_from_json(_record("twin", nt, nt_chunk)))
+        sched.run()
+        assert sched.job("twin").state == JobState.DONE
+        ref = _interior(sched, "twin")
+    _TWIN_CACHE[key] = ref
+    return ref
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+def _post(url, payload=None, timeout=10):
+    body = b"" if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(url, data=body, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# Public API / exports
+# ---------------------------------------------------------------------------
+
+def test_public_api_exports():
+    for sym in ("serve", "JobApiServer", "SnapshotQueryServer",
+                "BlockCache", "CachedSnapshot"):
+        assert hasattr(igg, sym), sym
+        assert sym in igg.__all__, sym
+    from implicitglobalgrid_tpu import service
+
+    for sym in ("QueueBackend", "DirectoryBackend", "jobspec_from_json"):
+        assert hasattr(service, sym), sym
+
+
+# ---------------------------------------------------------------------------
+# Queue backend: atomic claim partition (host-only — the >= 20-job bar)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serve
+def test_two_owner_claim_partition_no_double_admission(tmp_path):
+    """Two consumers over ONE directory backend: every record is
+    claimed by exactly one owner (atomic rename), none twice, none
+    lost — across 20 jobs."""
+    b1 = DirectoryBackend(tmp_path, owner="s1")
+    b2 = DirectoryBackend(tmp_path, owner="s2")
+    names = [f"job{i:02d}" for i in range(20)]
+    for n in names:
+        b1.submit(_record(n))
+    assert b2.pending() == sorted(names)
+    with pytest.raises(InvalidArgumentError, match="already enqueued"):
+        b2.submit(_record(names[0]))
+
+    claims = {"s1": [], "s2": []}
+    backends = [("s1", b1), ("s2", b2)]
+    i = 0
+    while True:
+        owner, b = backends[i % 2]
+        i += 1
+        got = b.claim()
+        if got is None:
+            if all(b.claim() is None for _, b in backends):
+                break
+            continue
+        assert got["record"]["name"] == got["name"]
+        claims[owner].append(got["name"])
+    assert not set(claims["s1"]) & set(claims["s2"])  # zero double-claims
+    assert sorted(claims["s1"] + claims["s2"]) == sorted(names)
+    assert claims["s1"] and claims["s2"]  # both actually took work
+    # a claimed record cannot be discarded; a fresh pending one can
+    assert b1.discard(names[0]) is False
+    b1.submit(_record("late"))
+    assert b2.discard("late") is True
+    assert b1.pending() == []
+
+
+@pytest.mark.serve
+def test_backend_control_protocol_roundtrip(tmp_path):
+    """The control channel is the PR-8 file protocol verbatim: drain /
+    cancel_<name> / resize_<name> under ``<root>/control/``, consumed
+    in filing order; unreadable resize payloads surface as None."""
+    b = DirectoryBackend(tmp_path)
+    b.control("cancel", "a")
+    b.control("drain")
+    b.control("resize", "b", {"new_dims": [1, 2, 2], "via": "auto"})
+    (tmp_path / "control" / "resize_torn").write_text("{not json")
+    (tmp_path / "control" / "resize_staged.tmp").write_text("{}")
+    reqs = DirectoryBackend(tmp_path).poll_control()
+    assert {r["request"] for r in reqs} == {"drain", "cancel", "resize"}
+    by = {(r["request"], r.get("job")): r for r in reqs}
+    assert by[("resize", "b")]["payload"] == {"new_dims": [1, 2, 2],
+                                              "via": "auto"}
+    assert by[("resize", "torn")]["payload"] is None
+    assert ("resize", "staged") not in by  # .tmp staging skipped
+    assert b.poll_control() == []  # consumed
+    with pytest.raises(InvalidArgumentError, match="payload"):
+        b.control("resize", "x")
+    with pytest.raises(InvalidArgumentError, match="Unknown control"):
+        b.control("pause", "x")
+    with pytest.raises(InvalidArgumentError, match="QueueBackend"):
+        MeshScheduler(queue="nope")
+    assert isinstance(b, QueueBackend)
+
+
+# ---------------------------------------------------------------------------
+# Block cache (host-only)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serve
+def test_block_cache_lru_eviction_and_stats():
+    blk = lambda: np.zeros(128, dtype=np.float64)  # 1 KiB
+    c = BlockCache(max_bytes=3 * 1024)
+    for k in ("a", "b", "c"):
+        assert c.get(k) is None
+        c.put(k, blk())
+    assert c.get("a") is not None  # freshen a; b is now LRU
+    c.put("d", blk())
+    assert c.get("b") is None and c.get("a") is not None
+    st = c.stats()
+    assert st["entries"] == 3 and st["bytes"] == 3 * 1024
+    assert st["evictions"] == 1 and st["hits"] == 2
+    c.put("huge", np.zeros(4096, dtype=np.float64))  # > whole budget
+    assert c.get("huge") is None and c.stats()["entries"] == 3
+    c.clear()
+    assert c.stats()["entries"] == 0 and c.stats()["bytes"] == 0
+    with pytest.raises(InvalidArgumentError, match="positive"):
+        BlockCache(0)
+    with pytest.raises(InvalidArgumentError, match="BlockCache"):
+        CachedSnapshot("/nonexistent", cache="nope")
+
+
+# ---------------------------------------------------------------------------
+# Reader coherence: staging dirs refused, torn containers typed
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serve
+@pytest.mark.io
+def test_reader_refuses_staging_and_torn_snapshot_dirs(tmp_path):
+    igg.init_global_grid(**GRID_A, quiet=True)
+    T = igg.zeros_g()
+    root = tmp_path / "snaps"
+    igg.write_snapshot(str(root), step=1, state={"T": T})
+    step, path = igg.list_snapshots(str(root))[0]
+
+    # a staging dir (writer mid-commit) is refused with the typed error
+    import shutil
+
+    stage = root / "step_0000000007.tmp-deadbeef"
+    shutil.copytree(path, stage)
+    with pytest.raises(IncoherentArgumentError, match="staging"):
+        igg.open_snapshot(str(stage))
+    # ... and list_snapshots never offers it
+    assert [s for s, _ in igg.list_snapshots(str(root))] == [1]
+
+    # a half-committed container (truncated meta, no sidecar — the
+    # pre-checksum worst case) raises the typed refusal, not zipfile's
+    torn = root / "step_0000000009"
+    shutil.copytree(path, torn)
+    (torn / "meta.npz").write_bytes(b"PK\x03\x04 truncated")
+    (torn / "meta.npz.sha256").unlink()
+    with pytest.raises(IncoherentArgumentError, match="half-committed"):
+        igg.open_snapshot(str(torn))
+    igg.finalize_global_grid()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance test: HTTP submit -> live scheduler -> HTTP control ->
+# bit-identity -> snapshot query with LRU hit
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serve
+@pytest.mark.service
+def test_http_job_e2e_bit_identical_with_query_service(tmp_path):
+    """Three jobs POSTed to the job API run under a live scheduler
+    polling the same backend: h1 (snapshotting) ends bit-identical to
+    its CLI-submitted twin, h2 is elastically resized over HTTP, h3 is
+    cancelled over HTTP mid-run; then the query service answers a
+    sub-box read of h1's committed snapshot byte-identical to
+    `read_global`, from the LRU on the second read."""
+    d = str(tmp_path / "svc")
+    snapdir = str(tmp_path / "snaps_h1")
+    ref = _twin_interior(tmp_path)
+
+    with JobApiServer(d) as api, \
+            MeshScheduler(policy="round_robin", flight_dir=d) as sched:
+        u = f"http://{api.host}:{api.port}"
+        code, rec = _post(u + "/v1/jobs", {"jobs": [
+            _record("h1", run={"nt_chunk": 4, "snapshot_dir": snapdir,
+                               "snapshot_every": 4}),
+            _record("h2"),
+            _record("h3"),
+        ]})
+        assert (code, rec["submitted"]) == (202, ["h1", "h2", "h3"])
+        _, body, _ = _get(u + "/v1/jobs")
+        jobs = json.loads(body)["jobs"]
+        assert {n: j["state"] for n, j in jobs.items()} == {
+            "h1": "pending", "h2": "pending", "h3": "pending"}
+        # /metrics rides the same port (one ops surface per server)
+        status, body, _ = _get(u + "/metrics")
+        assert status == 200 and b"igg_" in body
+
+        # the scheduler claims one record per decision; catch h2 and h3
+        # RUNNING to land resize/cancel on the live control path
+        def _step_until_running(name, budget=50):
+            for _ in range(budget):
+                if name in sched.jobs \
+                        and sched.job(name).state == JobState.RUNNING:
+                    return
+                sched.step()
+            raise AssertionError(f"{name} never reached RUNNING")
+
+        _step_until_running("h2")
+        code, rec = _post(u + "/v1/jobs/h2/resize",
+                          {"new_dims": [1, 2, 2]})
+        assert (code, rec["requested"]) == (202, "resize")
+        _step_until_running("h3")
+        code, rec = _post(u + "/v1/jobs/h3/cancel")
+        assert (code, rec["requested"]) == (202, "cancel")
+        assert "discarded" not in rec  # claimed: the control-file path
+        sched.run()
+
+        assert sched.job("h1").state == JobState.DONE
+        assert sched.job("h2").state == JobState.DONE
+        assert sched.job("h3").state == JobState.CANCELLED
+        # the HTTP resize actually re-blocked h2's decomposition
+        assert tuple(int(x) for x in sched.job("h2").gg.dims) == (1, 2, 2)
+        # bit-identity: HTTP tenants == the CLI twin (resize is exact)
+        assert np.array_equal(_interior(sched, "h1"), ref)
+        assert np.array_equal(_interior(sched, "h2"), ref)
+
+        # journal-derived status over HTTP agrees
+        _, body, _ = _get(u + "/v1/jobs/h1")
+        h1 = json.loads(body)
+        assert h1["state"] == "done" and "claimed_by" in h1
+        code, rec = _post(u + "/v1/jobs/h1/cancel")
+        assert code == 409  # terminal
+        code, rec = _post(u + "/v1/jobs/nope/cancel")
+        assert code == 404
+
+    # --- read side: the committed snapshots answer HTTP box reads ----------
+    with SnapshotQueryServer(snapdir) as q:
+        uq = f"http://{q.host}:{q.port}"
+        _, body, _ = _get(uq + "/v1/snapshots")
+        listing = json.loads(body)
+        assert [s["step"] for s in listing["snapshots"]] == [4, 8]
+        assert listing["snapshots"][0]["global_shapes"]["T"] == [10, 10, 6]
+
+        box = (slice(1, 7), slice(2, 9), slice(0, 4))
+        path8 = dict(igg.list_snapshots(snapdir))[8]
+        expect = igg.open_snapshot(path8).read_global(
+            "T", tuple((s.start, s.stop) for s in box))
+        status, body, hdrs = _get(uq + "/v1/snapshots/8/T?box=1:7,2:9,0:4")
+        arr = np.load(io.BytesIO(body))
+        assert status == 200 and hdrs["X-IGG-Shape"] == "6,7,4"
+        assert arr.dtype == np.float64
+        assert np.array_equal(arr, expect)  # byte-identical to read_global
+        # ... and to the final interior of the job that wrote it
+        assert np.array_equal(arr, ref[box])
+
+        # warm re-read: answered from the LRU, still byte-identical
+        status, body2, hdrs2 = _get(
+            uq + "/v1/snapshots/8/T?box=1:7,2:9,0:4")
+        assert int(hdrs2["X-IGG-Cache-Hits"]) > 0
+        assert int(hdrs["X-IGG-Cache-Hits"]) == 0
+        assert body2 == body
+        assert q.cache.stats()["hits"] > 0
+
+        # point read + error surfaces
+        _, body, _ = _get(uq + "/v1/snapshots/8/T?point=3,4,2")
+        p = json.loads(body)
+        assert p["value"] == float(ref[3, 4, 2])
+        for bad, code in (("/v1/snapshots/8/T?box=banana", 400),
+                          ("/v1/snapshots/8/T?box=0:2", 400),
+                          ("/v1/snapshots/8/nope", 404),
+                          ("/v1/snapshots/99/T", 404),
+                          ("/v1/snapshots/8/T?box=0:2,0:2,0:2&point=1,1,1",
+                           400)):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(uq + bad)
+            assert ei.value.code == code, bad
+
+
+# ---------------------------------------------------------------------------
+# Deadline-aware admission + deadline_missed surfacing
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serve
+@pytest.mark.service
+def test_deadline_rejection_priced_and_journaled(tmp_path):
+    """A job whose `predict_step` price provably busts its deadline is
+    REJECTED at admission with the verdict journaled; an admissible
+    deadline job runs (its run-level budget derived from the deadline),
+    and a crossed run-level budget fires ONE deadline_missed event +
+    counter. `service_report` reproduces all of it."""
+    igg.reset_metrics()
+    d = str(tmp_path / "svc")
+    with MeshScheduler(policy="fifo", flight_dir=d) as sched:
+        # provably over: ~1e7 modeled steps cannot fit half a second
+        sched.submit(jobspec_from_json(
+            _record("over", nt=10_000_000, nt_chunk=1_000_000,
+                    deadline_s=0.5)))
+        # admissible, generous deadline — but a tiny RUN-level budget,
+        # so it finishes DONE with the miss surfaced
+        sched.submit(jobspec_from_json(
+            _record("ok", nt=4, nt_chunk=2, deadline_s=3600.0,
+                    run={"nt_chunk": 2, "deadline_s": 1e-6})))
+        sched.run()
+        over = sched.job("over")
+        assert over.state == JobState.REJECTED
+        assert "admission rejected" in over.error
+        assert sched.job("ok").state == JobState.DONE
+        assert sched.job("ok").run.deadline_missed is True
+    fam = igg.metrics_registry().get("igg_job_deadline_missed_total")
+    assert fam is not None and fam.value() >= 1
+
+    rep = igg.service_report(d)
+    assert rep["states"] == {"rejected": 1, "done": 1}
+    adm = rep["jobs"]["over"]["admission"]
+    assert adm["verdict"] == "reject" and adm["priced_by"] == "predict_step"
+    assert adm["admit_price_s"] > adm["budget_s"]
+    assert adm["nt"] == 10_000_000 and adm["deadline_s"] == 0.5
+    assert adm["step_price_s"] > 0 and adm["bound"]
+    # the rejection message the API/CLI shows is the journaled verdict
+    assert f"{adm['admit_price_s']:.3g}" in rep["jobs"]["over"]["error"]
+    ok = rep["jobs"]["ok"]
+    assert ok["admission"]["verdict"] == "admit"
+    assert ok["deadline_missed"]["deadline_s"] == 1e-6
+    assert ok["state"] == "done"
+
+
+@pytest.mark.serve
+def test_deadline_validation_and_unpriceable_jobs_admit(tmp_path):
+    """deadline_s must be positive wherever it appears; a job the model
+    CANNOT price (custom setup, no model name) is admitted — admission
+    only rejects what it can prove — with the unpriceable verdict
+    journaled."""
+    from implicitglobalgrid_tpu.service import JobSpec
+
+    with pytest.raises(InvalidArgumentError, match="deadline_s"):
+        jobspec_from_json(_record("x", deadline_s=-1.0))
+
+    def _setup():
+        from implicitglobalgrid_tpu.models import (
+            diffusion_step_local, init_diffusion3d,
+        )
+
+        T, Cp, p = init_diffusion3d(dtype=np.float64)
+
+        def step(s):
+            return {"T": diffusion_step_local(s["T"], s["Cp"], p, "xla"),
+                    "Cp": s["Cp"]}
+
+        return step, {"T": T, "Cp": Cp}
+
+    # the run-level budget is validated at driver construction
+    igg.init_global_grid(**GRID_A, quiet=True)
+    step, state = _setup()
+    with pytest.raises(InvalidArgumentError, match="deadline_s"):
+        igg.run_resilient(step, state, 2, nt_chunk=2, deadline_s=0.0)
+    igg.finalize_global_grid()
+
+    d = str(tmp_path / "svc")
+    with MeshScheduler(flight_dir=d) as sched:
+        sched.submit(JobSpec(
+            name="custom", setup=_setup, nt=4, grid=GRID_A,
+            deadline_s=0.5,  # tight — but unpriceable, so it runs
+            run=igg.RunSpec(nt_chunk=2, key=("serve", "custom"))))
+        sched.run()
+        assert sched.job("custom").state == JobState.DONE
+    adm = igg.service_report(d)["jobs"]["custom"]["admission"]
+    assert adm["verdict"] == "admit" and adm["priced_by"] == "unpriceable"
+
+
+# ---------------------------------------------------------------------------
+# Two schedulers, one backend: partition + fault isolation + bit-identity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serve
+@pytest.mark.service
+@pytest.mark.faults
+def test_two_schedulers_share_backend_fault_isolated_bit_identical(
+        tmp_path):
+    """Two LIVE schedulers drain one queue: every record admitted by
+    exactly one (journal-attributed claim), a NaNPoke in one tenant
+    trips ITS guard only, and every tenant — both schedulers, recovery
+    included — ends bit-identical to the CLI twin."""
+    from implicitglobalgrid_tpu.service import JobSpec
+    from implicitglobalgrid_tpu.service.job import builtin_setup
+
+    ref = _twin_interior(tmp_path)
+    _reset_health_counters()
+    qroot = str(tmp_path / "q")
+    b1 = DirectoryBackend(qroot, owner="s1")
+    b2 = DirectoryBackend(qroot, owner="s2")
+    for n in ("t1", "t2", "t3"):
+        b1.submit(_record(n))
+    d1, d2 = str(tmp_path / "svc1"), str(tmp_path / "svc2")
+    with MeshScheduler(policy="round_robin", flight_dir=d1,
+                       queue=b1) as s1, \
+            MeshScheduler(policy="round_robin", flight_dir=d2,
+                          queue=b2) as s2:
+        # one direct-submitted faulty tenant on s1 (faults are live
+        # objects — they ride JobSpec, not queue JSON)
+        s1.submit(JobSpec(
+            name="tfault", setup=builtin_setup("diffusion3d", "float64"),
+            nt=8, grid=GRID_A, model="diffusion3d",
+            run=igg.RunSpec(
+                nt_chunk=4, key=("serve", "tfault"),
+                checkpoint_dir=str(tmp_path / "ck"),
+                faults=(igg.NaNPoke(step=6, name="T"),))))
+        for _ in range(200):
+            p1, p2 = s1.step(), s2.step()
+            if not p1 and not p2 and not b1.pending():
+                break
+        assert not b1.pending()
+        done = {}
+        for sched in (s1, s2):
+            for name, job in sched.jobs.items():
+                assert job.state == JobState.DONE, (name, job.state)
+                done[name] = _interior(sched, name)
+        # zero double-admissions: the four tenants partitioned exactly
+        assert len(done) == 4
+        assert set(done) == {"t1", "t2", "t3", "tfault"}
+        assert "tfault" in s1.jobs
+        assert s1.jobs and s2.jobs  # both actually served tenants
+        # the fault stayed in its tenant...
+        c = _health_counters()
+        assert c["guard_trips"] == 1 and c["rollbacks"] == 1
+        # ... and EVERY tenant is bit-identical to the CLI twin
+        for name, interior in done.items():
+            assert np.array_equal(interior, ref), name
+
+    # the journals attribute every claim to exactly one owner
+    claimed = {}
+    for dd in (d1, d2):
+        for name, r in igg.service_report(dd)["jobs"].items():
+            if "claimed_by" in r:
+                assert name not in claimed, f"{name} claimed twice"
+                claimed[name] = r["claimed_by"]
+    assert set(claimed) == {"t1", "t2", "t3"}
+    assert {v for v in claimed.values()} <= {"s1", "s2"}
+
+
+# ---------------------------------------------------------------------------
+# Job API validation (no mesh)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serve
+def test_job_api_validation_and_status_merge(tmp_path):
+    d = str(tmp_path / "svc")
+    with JobApiServer(d) as api:
+        u = f"http://{api.host}:{api.port}"
+        code, rec = _post(u + "/v1/jobs", {"jobs": [{"name": "a"}]})
+        assert code == 400 and "missing required" in rec["error"]
+        code, rec = _post(
+            u + "/v1/jobs", {"jobs": [_record("a"),
+                                      _record("b", run={"bogus": 1})]})
+        assert code == 400 and "bad 'run' knob" in rec["error"]
+        assert api.backend.pending() == []  # nothing half-submitted
+        # single-record form; duplicates 409 against queue AND batch
+        code, rec = _post(u + "/v1/jobs", _record("a"))
+        assert (code, rec["submitted"]) == (202, ["a"])
+        code, rec = _post(u + "/v1/jobs", _record("a"))
+        assert code == 409
+        code, rec = _post(u + "/v1/jobs",
+                          {"jobs": [_record("c"), _record("c")]})
+        assert code == 409 and api.backend.pending() == ["a"]
+        # resize validation; unknown routes/jobs
+        code, rec = _post(u + "/v1/jobs/a/resize", {"new_dims": [1, 2]})
+        assert code == 400 and "new_dims" in rec["error"]
+        code, rec = _post(u + "/v1/jobs/a/resize",
+                          {"new_dims": [1, 2, 2], "via": "magic"})
+        assert code == 400 and "via" in rec["error"]
+        code, rec = _post(u + "/v1/jobs/zzz/cancel")
+        assert code == 404
+        code, rec = _post(u + "/v1/nope")
+        assert code == 404
+        code, rec = _post(u + "/v1/jobs", None)
+        assert code == 400
+        # pending cancel = atomic discard, before any scheduler claims
+        code, rec = _post(u + "/v1/jobs/a/cancel")
+        assert (code, rec.get("discarded")) == (202, True)
+        assert api.backend.pending() == []
+        # drain files the global control request
+        code, rec = _post(u + "/v1/drain")
+        assert (code, rec["requested"]) == (202, "drain")
+        assert DirectoryBackend(d).poll_control() == [{"request": "drain"}]
+
+
+@pytest.mark.serve
+def test_query_server_validation(tmp_path):
+    with pytest.raises(InvalidArgumentError, match="root"):
+        SnapshotQueryServer(str(tmp_path / "nope"))
+    root = tmp_path / "empty"
+    root.mkdir()
+    with SnapshotQueryServer(str(root), cache_bytes=1024) as q:
+        u = f"http://{q.host}:{q.port}"
+        _, body, _ = _get(u + "/v1/snapshots")
+        rec = json.loads(body)
+        assert rec["snapshots"] == [] and rec["cache"]["max_bytes"] == 1024
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(u + "/v1/snapshots/3/T")
+        assert ei.value.code == 404
+        # write side is refused outright
+        code, rec = _post(u + "/v1/snapshots")
+        assert code == 405
