@@ -208,8 +208,25 @@ def main() -> None:
     # `bench_telemetry.run_telemetry_overhead` (shared with the standalone).
     import bench_telemetry
 
-    for row in bench_telemetry.run_telemetry_overhead(dims3, cpu):
+    tel_rows = bench_telemetry.run_telemetry_overhead(dims3, cpu)
+    for row in tel_rows:
         results.append(bench_util.emit(row))
+
+    # --- live observability plane (ISSUE 18) --------------------------------
+    # the in-process alert cadence (tail drain + default rule pack per
+    # chunk boundary — what MeshScheduler(alerts=True) adds per slice) as
+    # a fraction of the telemetry leg's off-run time, gated < 2%; the
+    # /v1/observe round trip and /v1/events append-to-line lag ride the
+    # perfdb trajectory. Config owned by `bench_telemetry.live_plane_rows`.
+    tel_ref = next(r for r in tel_rows
+                   if r["metric"] == "telemetry_overhead_frac")
+    live_rows = bench_telemetry.live_plane_rows(
+        tel_ref["off_run_s_median"],
+        n_boundaries=tel_ref["nt"] // tel_ref["nt_chunk"])
+    for row in live_rows:
+        results.append(bench_util.emit(row))
+    live_ok = next(r["value"] for r in live_rows
+                   if r["metric"] == "live_tail_overhead_frac") < 0.02
 
     # --- mesh observability: trace pipeline + server-off step-loop cost ----
     # aggregation+straggler+Perfetto-export wall time on a 10k-event
@@ -369,7 +386,7 @@ def main() -> None:
     lint_failed = not ruff_missing and lint.returncode != 0
     if (not gate["ok"] or lint_failed or not coalesce8_ok
             or not ensemble_ok or not tuned_ok or not reshard_ok
-            or not staged_ok or not serve_ok) \
+            or not staged_ok or not serve_ok or not live_ok) \
             and os.environ.get("IGG_BENCH_STRICT") == "1":
         sys.exit(1)
 

@@ -77,6 +77,8 @@ from .telemetry import (
     export_chrome_trace,
     MetricsServer, start_metrics_server, stop_metrics_server,
     metrics_server,
+    FlightTail, LiveAggregate, AlertRule, AlertEngine, default_rule_pack,
+    log_sink, ControlFileSink, WebhookSink,
     MachineProfile, StepWorkload, PerfWatch, default_machine_profile,
     load_machine_profile, save_machine_profile, predict_step,
     predict_reshard, calibrate_machine, perfdb_add, perfdb_check,
@@ -95,7 +97,8 @@ from .service import (
 )
 from . import serve
 from .serve import (
-    BlockCache, CachedSnapshot, JobApiServer, SnapshotQueryServer,
+    BlockCache, CachedSnapshot, JobApiServer, ObservePlane, ObserveServer,
+    SnapshotQueryServer,
 )
 from . import analysis
 from .analysis import (
@@ -133,6 +136,11 @@ __all__ = [
     # serving tier (networked job API + read-side snapshot query service)
     "serve", "JobApiServer", "SnapshotQueryServer", "BlockCache",
     "CachedSnapshot",
+    # live observability plane (incremental tailing, SLO/alert engine,
+    # streaming ops endpoints)
+    "FlightTail", "LiveAggregate", "AlertRule", "AlertEngine",
+    "default_rule_pack", "log_sink", "ControlFileSink", "WebhookSink",
+    "ObservePlane", "ObserveServer",
     # on-device elastic resharding (HBM-to-HBM re-blocking, no disk)
     "reshard", "ReshardPlan", "build_reshard_plan", "reshard_contract",
     "reshard_state",

@@ -272,6 +272,9 @@ class ResilientRun:
         self.deadline_s = (None if spec.deadline_s is None
                            else float(spec.deadline_s))
         self.deadline_missed = False
+        # live slack: remaining budget minus the priced cost of the
+        # remaining steps, refreshed at every boundary (`_check_deadline`)
+        self.deadline_slack_s = None
         self._deadline_t0 = time.monotonic()
         if spec.audit_lints is not None and not spec.audit:
             raise InvalidArgumentError(
@@ -670,22 +673,47 @@ class ResilientRun:
         return not self._finished
 
     def _check_deadline(self) -> None:
-        """Boundary-granular deadline watch: past the ``deadline_s``
-        budget, record ONE ``deadline_missed`` flight event and bump
-        ``igg_job_deadline_missed_total`` — the run keeps going (a
-        deadline is an operator contract, not a kill switch; the
-        scheduler journals it and `service_report` surfaces it)."""
-        if self.deadline_s is None or self.deadline_missed:
+        """Boundary-granular deadline watch. Every boundary of a
+        deadline-budgeted run computes the LIVE SLACK — remaining budget
+        minus the priced cost of the remaining steps (the attached
+        `predict_step` model when one backs the run, else the PerfWatch
+        warm measured baseline, else the budget alone) — stamps the
+        ``igg_deadline_slack_seconds`` gauge, and records a
+        ``deadline_slack`` flight event: the signal the live plane's
+        deadline-slack-burn alert subscribes to, so a bust is visible as
+        a trend long before the miss. Past the budget, record ONE
+        ``deadline_missed`` flight event (from the same computation:
+        ``budget_s < 0``) and bump ``igg_job_deadline_missed_total`` —
+        the run keeps going (a deadline is an operator contract, not a
+        kill switch; the scheduler journals it and `service_report`
+        surfaces it)."""
+        if self.deadline_s is None:
             return
-        elapsed_s = time.monotonic() - self._deadline_t0
-        if elapsed_s > self.deadline_s:
-            self.deadline_missed = True
-            from ..telemetry.hooks import note_deadline_missed
+        from ..telemetry.hooks import (
+            note_deadline_missed, note_deadline_slack,
+        )
 
+        elapsed_s = time.monotonic() - self._deadline_t0
+        budget_s = self.deadline_s - elapsed_s
+        step_s = self._model_step_s
+        priced_by = "perf_model" if step_s else None
+        if not step_s and self.watch is not None:
+            step_s = self.watch.baseline_s()
+            priced_by = "measured" if step_s else None
+        remaining = max(0, self.nt - self.step)
+        slack_s = budget_s - (step_s * remaining if step_s else 0.0)
+        self.deadline_slack_s = slack_s
+        note_deadline_slack(slack_s)
+        self._record_event("deadline_slack", step=self.step,
+                           slack_s=slack_s, budget_s=budget_s,
+                           priced_step_s=step_s, priced_by=priced_by,
+                           remaining_steps=remaining)
+        if not self.deadline_missed and elapsed_s > self.deadline_s:
+            self.deadline_missed = True
             note_deadline_missed()
             self._record_event("deadline_missed", step=self.step,
                                deadline_s=self.deadline_s,
-                               elapsed_s=elapsed_s)
+                               elapsed_s=elapsed_s, slack_s=slack_s)
 
     def _iterate(self):
         np = self._np

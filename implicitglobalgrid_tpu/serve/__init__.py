@@ -13,8 +13,15 @@ neither a filesystem mount nor an accelerator runtime:
   sub-box reads of any committed snapshot, streamed as ``.npy`` bytes,
   answered through a bounded `BlockCache` LRU (`serve.cache`) of
   checksum-verified decoded blocks. Replicas never touch the mesh.
+- `ObservePlane` / `ObserveServer` (`serve.observe`) — the LIVE side:
+  ``GET /v1/observe`` (derived-signal snapshot: rolling step quantiles,
+  deadline slack, stragglers, queue pressure, active alerts) and
+  ``GET /v1/events?since=<seq>`` (the merged clock-aligned flight feed
+  as resumable chunked NDJSON), tail-following the flight directory
+  incrementally (`telemetry.LiveAggregate`). Mounted on `JobApiServer`
+  by default; `ObserveServer` serves it standalone.
 
-Both ride on `telemetry.MetricsServer` (``routes=``), so every
+All ride on `telemetry.MetricsServer` (``routes=``), so every
 endpoint also serves ``/metrics`` + ``/healthz`` and binds loopback by
 default. See docs/serving.md for the API reference and deployment
 notes.
@@ -22,8 +29,10 @@ notes.
 
 from .api import JobApiServer
 from .cache import BlockCache, CachedSnapshot
+from .observe import ObservePlane, ObserveServer
 from .query import SnapshotQueryServer
 
 __all__ = [
     "JobApiServer", "SnapshotQueryServer", "BlockCache", "CachedSnapshot",
+    "ObservePlane", "ObserveServer",
 ]

@@ -28,6 +28,10 @@ come free):
 - ``POST /v1/jobs/<name>/resize`` — body ``{"new_dims": [dx,dy,dz],
   "via"?: "auto"|"device"|"checkpoint"}`` -> the resize control file.
 - ``POST /v1/drain`` — the global drain request.
+- ``GET /v1/observe`` / ``GET /v1/events?since=<seq>`` — the live
+  observability plane (`serve.observe.ObservePlane`, mounted over the
+  same flight directory unless ``observe=False``): the derived-signal
+  snapshot and the resumable chunked-NDJSON event stream.
 
 SECURITY: inherits `MetricsServer`'s loopback-by-default bind; the
 surface is unauthenticated by design — front it with an authenticating
@@ -60,7 +64,8 @@ class JobApiServer:
 
     def __init__(self, flight_dir, port: int = 0, *,
                  host: str = "127.0.0.1", backend: QueueBackend | None = None,
-                 registry=None):
+                 registry=None, observe: bool = True,
+                 observe_window: int = 16):
         self.flight_dir = os.fspath(flight_dir)
         os.makedirs(self.flight_dir, exist_ok=True)
         if backend is not None and not isinstance(backend, QueueBackend):
@@ -69,6 +74,16 @@ class JobApiServer:
                 f"{type(backend).__name__}.")
         self.backend = (backend if backend is not None
                         else DirectoryBackend(self.flight_dir))
+        # the live plane rides the same server: /v1/observe (derived
+        # signals + alerts) and /v1/events (streaming feed) over the
+        # same flight directory the job routes reconstruct state from
+        self.observe = None
+        if observe:
+            from .observe import ObservePlane
+
+            self.observe = ObservePlane(self.flight_dir,
+                                        backend=self.backend,
+                                        window=observe_window)
         self._server = MetricsServer(port, host=host, registry=registry,
                                      routes=self._route)
         self.host = self._server.host
@@ -109,6 +124,10 @@ class JobApiServer:
             "application/json"
 
     def _route(self, method: str, path: str, query: str, body: bytes):
+        if self.observe is not None:
+            resp = self.observe.routes(method, path, query, body)
+            if resp is not None:
+                return resp
         if path == "/v1/drain" and method == "POST":
             self.backend.control("drain")
             return self._json(202, {"requested": "drain"})

@@ -67,6 +67,19 @@ class QueueBackend:
         """Names of unclaimed records, in claim order."""
         raise NotImplementedError
 
+    def pending_count(self) -> int:
+        """Number of unclaimed records — the queue-pressure gauge
+        (``igg_queue_pending``). Backends override when they can count
+        cheaper than listing; the default is ``len(self.pending())``."""
+        return len(self.pending())
+
+    def oldest_age_s(self) -> float | None:
+        """Age in seconds of the OLDEST unclaimed record (None when the
+        queue is empty or the backend cannot tell) — the starvation
+        signal (``igg_queue_oldest_age_seconds``) next to the count.
+        Purely observational: never claims, never mutates."""
+        return None
+
     def claim(self) -> dict | None:
         """Atomically claim the next pending record. Returns ``None``
         when the queue is empty, else ``{"name", "record", "error"}``
@@ -176,6 +189,29 @@ class DirectoryBackend(QueueBackend):
             return []
         return [f[:-len(".json")] for f in names
                 if f.endswith(".json") and not f.startswith(".")]
+
+    def pending_count(self) -> int:
+        # one listdir, no stat calls — cheap enough to stamp per
+        # scheduling decision
+        try:
+            names = os.listdir(self.queue_dir)
+        except FileNotFoundError:
+            return 0
+        return sum(1 for f in names
+                   if f.endswith(".json") and not f.startswith("."))
+
+    def oldest_age_s(self) -> float | None:
+        import time
+
+        oldest = None
+        for name in self.pending():
+            path = os.path.join(self.queue_dir, name + ".json")
+            try:
+                m = os.stat(path).st_mtime
+            except FileNotFoundError:
+                continue  # claimed between the listing and the stat
+            oldest = m if oldest is None else min(oldest, m)
+        return None if oldest is None else max(0.0, time.time() - oldest)
 
     def claim(self) -> dict | None:
         for name in self.pending():

@@ -95,6 +95,7 @@ def service_report(source, *, include_jobs: bool = True) -> dict:
 
     schedule: list = []
     submit_rejected: list = []
+    alerts: list = []
     switches = 0
     prev_job = None
     queued = running = 0
@@ -131,12 +132,20 @@ def service_report(source, *, include_jobs: bool = True) -> dict:
         elif k == "submit_rejected":
             submit_rejected.append({"job": e.get("job"),
                                     "error": e.get("error")})
+        elif k == "alert":
+            alerts.append(e)
+            if e.get("job"):
+                rec(e["job"]).setdefault("alerts", []).append(
+                    {"rule": e.get("rule"), "state": e.get("state"),
+                     "severity": e.get("severity"), "t": e.get("t")})
         elif k == "slice":
             r = rec(e["job"])
             r["slices"] += 1
             r["slice_s_total"] += float(e.get("dur_s", 0.0) or 0.0)
             r["wait_s_total"] += float(e.get("wait_s", 0.0) or 0.0)
             r["step"] = e.get("step")
+            if e.get("slack_s") is not None:
+                r["slack_s_last"] = e["slack_s"]
             schedule.append({"t": e.get("t"), "job": e["job"],
                              "slice": e.get("slice"), "step": e.get("step"),
                              "dur_s": e.get("dur_s"),
@@ -187,6 +196,9 @@ def service_report(source, *, include_jobs: bool = True) -> dict:
         "jobs": {name: jobs[name] for name in order},
         "schedule": schedule,
     }
+    from ..telemetry.report import _alerts_section
+
+    report["alerts"] = _alerts_section(alerts)
     if submit_rejected:
         report["submit_rejected"] = submit_rejected
     if stop is not None:
@@ -268,6 +280,16 @@ def export_service_trace(source, out=None):
             queued -= 1
             trace.append({"ph": "C", "pid": 0, "name": "igg_jobs_queued",
                           "ts": us(t), "args": {"jobs": queued}})
+        elif k == "alert":
+            trace.append({"ph": "i", "pid": 0, "tid": 0, "cat": "alert",
+                          "name": (f"alert {e.get('rule')} "
+                                   f"{e.get('state')}"),
+                          "ts": us(t), "s": "p",
+                          "args": {"rule": e.get("rule"),
+                                   "severity": e.get("severity"),
+                                   "state": e.get("state"),
+                                   "job": e.get("job"),
+                                   "value": e.get("value")}})
         elif k in ("job_done", "job_failed", "job_cancelled",
                    "job_rejected", "deadline_missed", "drain",
                    "scheduler_start", "scheduler_stop", "control"):

@@ -50,6 +50,7 @@ import time
 from ..parallel import topology as top
 from ..runtime.driver import ResilientRun
 from ..telemetry import hooks
+from ..telemetry.live import AlertEngine
 from ..telemetry.recorder import FlightRecorder, use_flight_recorder
 from ..utils.exceptions import InvalidArgumentError
 from .backend import DirectoryBackend, QueueBackend
@@ -111,7 +112,8 @@ class MeshScheduler:
     def __init__(self, *, policy="fifo", flight_dir=None,
                  metrics_port: int | None = None,
                  healthz_max_age_s: float | None = None,
-                 queue: QueueBackend | None = None):
+                 queue: QueueBackend | None = None,
+                 alerts=None, alert_sinks=()):
         self.policy = resolve_policy(policy)
         self.flight_dir = None if flight_dir is None else str(flight_dir)
         self.jobs: dict = {}
@@ -146,6 +148,30 @@ class MeshScheduler:
         self.queue = queue
         if queue is None and self.flight_dir is not None:
             self.queue = DirectoryBackend(self.flight_dir)
+        # the in-process alert engine (ISSUE 18): ``alerts=True`` turns
+        # on the default rule pack, an iterable of AlertRules customizes
+        # it, a ready AlertEngine is adopted as-is (sinks appended). It
+        # evaluates over the scheduler's OWN live state after every
+        # granted slice and journals every transition through the
+        # scheduler's single-writer journal — `telemetry.LiveAggregate`
+        # is the observer-side twin tailing the same directory.
+        self.alert_engine = None
+        if isinstance(alerts, AlertEngine):
+            self.alert_engine = alerts
+            self.alert_engine.sinks.extend(alert_sinks)
+            if self.alert_engine.journal is None:
+                self.alert_engine.journal = self._log
+        elif alerts is True or alerts == "default":
+            self.alert_engine = AlertEngine(sinks=alert_sinks,
+                                            journal=self._log)
+        elif alerts:
+            self.alert_engine = AlertEngine(list(alerts),
+                                            sinks=alert_sinks,
+                                            journal=self._log)
+        elif alert_sinks:
+            raise InvalidArgumentError(
+                "alert_sinks without alerts: pass alerts=True (default "
+                "rule pack), a rule list, or an AlertEngine.")
         try:
             if metrics_port is not None:
                 from ..telemetry.server import start_metrics_server
@@ -349,6 +375,7 @@ class MeshScheduler:
         self._check_open()
         self._poll_control()
         self._poll_queue()
+        self._update_backlog_gauges()
         cands = self.runnable()
         for j in [j for j in cands if j.cancel_requested]:
             self._finalize(j, JobState.CANCELLED)
@@ -358,6 +385,11 @@ class MeshScheduler:
             return False
         job = self.policy.pick(cands)
         self._slice(job)
+        if self.alert_engine is not None:
+            # the slice boundary IS the alert-evaluation cadence:
+            # signals only change when a slice ran, and a sink's control
+            # file lands before the very next _poll_control
+            self.alert_engine.evaluate(self._live_signals())
         hooks.note_scheduler_heartbeat(granted=True)
         return True
 
@@ -377,6 +409,52 @@ class MeshScheduler:
         hooks.note_queue_depth(
             sum(1 for j in self._order if j.state == JobState.QUEUED),
             sum(1 for j in self._order if j.state == JobState.RUNNING))
+
+    def _update_backlog_gauges(self) -> None:
+        """Queue-pressure pair from the backend: unclaimed records +
+        oldest-record age (upstream of the admitted-jobs gauges)."""
+        if self.queue is None:
+            return
+        hooks.note_queue_backlog(self.queue.pending_count(),
+                                 self.queue.oldest_age_s())
+
+    def _live_signals(self) -> dict:
+        """The scheduler-side live snapshot the in-process alert engine
+        evaluates against — same shape (``jobs`` / ``procs`` / ``queue``
+        / ``scheduler`` keys, same signal names) as
+        `telemetry.LiveAggregate.snapshot`, built from direct state
+        instead of tailed files. ``procs`` is empty here (barrier
+        spreads need the multi-process tail view); the straggler rule
+        simply stays silent in-process."""
+        jobs = {}
+        for j in self._order:
+            run, st = j.run, j.status()
+            watch = None if run is None else getattr(run, "watch", None)
+            jobs[j.name] = {
+                "state": st["state"], "step": st["step"],
+                "nt": st["nt"], "slices": st["slices"],
+                "guard_trips": st["guard_trips"],
+                "deadline_slack_s": None if run is None
+                else getattr(run, "deadline_slack_s", None),
+                "deadline_missed": bool(
+                    run is not None
+                    and getattr(run, "deadline_missed", False)),
+                "perf_regressions": 0 if watch is None
+                else getattr(watch, "regressions", 0),
+            }
+        queue = {
+            "queued": sum(1 for j in self._order
+                          if j.state == JobState.QUEUED),
+            "running": sum(1 for j in self._order
+                           if j.state == JobState.RUNNING),
+        }
+        if self.queue is not None:
+            queue["pending"] = self.queue.pending_count()
+            queue["oldest_age_s"] = self.queue.oldest_age_s()
+        return {"t": time.time(), "jobs": jobs, "procs": {},
+                "queue": queue,
+                "scheduler": {"slices": self.slices,
+                              "draining": self._draining}}
 
     def _poll_control(self) -> None:
         """Control channel: `tools jobs cancel|drain|resize` and the
@@ -493,7 +571,16 @@ class MeshScheduler:
             top.retain_epoch(job.gg.epoch)
             with use_flight_recorder(job.recorder), knob_scope:
                 step_local, state = job.spec.setup()
-                self._price_admission(job, run_spec, tuned, state)
+                unit_price_s = self._price_admission(job, run_spec,
+                                                     tuned, state)
+                if unit_price_s is not None \
+                        and run_spec.perf_model is None:
+                    # hand the admission price to the driver as its
+                    # perf model: the deadline-slack gauge then prices
+                    # remaining work from the first boundary instead of
+                    # waiting for a warm measured baseline
+                    run_spec = dataclasses.replace(
+                        run_spec, perf_model=float(unit_price_s))
                 if job.spec.deadline_s is not None \
                         and run_spec.deadline_s is None:
                     # hand the REMAINING budget to the runtime surface:
@@ -524,7 +611,7 @@ class MeshScheduler:
         self._log("job_admitted", job=job.name, admit_s=job.admit_s,
                   epoch=int(job.gg.epoch))
 
-    def _price_admission(self, job: Job, run_spec, tuned, state) -> None:
+    def _price_admission(self, job: Job, run_spec, tuned, state):
         """Deadline-aware admission (runs under the job's grid, state
         built): price the job's expected mesh-seconds with the PR-6
         cost model — ``predict_step`` on the job's OWN field shapes,
@@ -534,10 +621,14 @@ class MeshScheduler:
         journaled as ``admission_priced`` with the full pricing inputs,
         so `service_report` can defend it post-hoc. Unpriceable jobs
         (no ``model``, a non-workload model, a cost-model refusal)
-        always admit — admission only rejects what it can PROVE."""
+        always admit — admission only rejects what it can PROVE.
+
+        Returns the priced per-nt-unit step cost (seconds) on a priced
+        admit, None otherwise — `_admit` hands it to the driver as the
+        run's perf model when the spec left one unset."""
         spec = job.spec
         if spec.deadline_s is None:
-            return
+            return None
         from ..telemetry.perfmodel import (
             STEP_WORKLOADS, default_machine_profile, predict_step,
         )
@@ -550,7 +641,7 @@ class MeshScheduler:
                       priced_by="unpriceable", model=spec.model,
                       deadline_s=float(spec.deadline_s),
                       waited_s=waited_s, budget_s=budget_s)
-            return
+            return None
         from ..models.common import resolve_comm_every
 
         E = run_spec.ensemble
@@ -583,7 +674,7 @@ class MeshScheduler:
                       error=f"{type(e).__name__}: {e}",
                       deadline_s=float(spec.deadline_s),
                       waited_s=waited_s, budget_s=budget_s)
-            return
+            return None
         cadence = resolve_comm_every(knobs["comm_every"])
         # a deep cadence makes the job's step the SUPER-STEP (the
         # builtin setups' rule): one nt unit = cadence.cycle physical
@@ -602,6 +693,7 @@ class MeshScheduler:
         self._log("admission_priced", **rec)
         if verdict == "reject":
             raise _DeadlineRejected(rec)
+        return pred["step_s"] * steps_per_unit
 
     def _slice(self, job: Job) -> None:
         """Grant ``job`` one chunk-boundary slice (admitting it first if
@@ -725,10 +817,12 @@ class MeshScheduler:
         total = self._audit_total()
         findings = total - self._audit_seen
         self._audit_seen = total
+        slack_s = None if job.run is None \
+            else getattr(job.run, "deadline_slack_s", None)
         hooks.observe_job_slice(
             job.scope, step=job.step, slice_s=slice_s, wait_s=wait_s,
             perf_step_s=perf_step_s, perf_ratio=perf_ratio,
-            audit_findings=max(0.0, findings))
+            audit_findings=max(0.0, findings), slack_s=slack_s)
         # batched (ensemble) jobs: mirror the LAST chunk's per-member
         # guard verdicts into this job's scoped registry — the global
         # igg_member_* series flap between tenants exactly like the perf
@@ -744,7 +838,7 @@ class MeshScheduler:
                 hooks.observe_member_health(members, scope=job.scope)
         self._log("slice", job=job.name, slice=self.slices - 1,
                   step=job.step, dur_s=slice_s, wait_s=wait_s,
-                  policy=self.policy.name)
+                  policy=self.policy.name, slack_s=slack_s)
 
     def _finalize(self, job: Job, state: str) -> None:
         """Move a job to a terminal state and release its resources (run

@@ -31,7 +31,18 @@ reference's whole surface, SURVEY §5.4):
   checkpoint/snapshot spans, instant guard events, counter tracks).
 - `server` — `start_metrics_server`: opt-in stdlib HTTP thread serving
   ``/metrics`` (Prometheus exposition) and ``/healthz`` (driver
-  heartbeat age); started by `run_resilient(metrics_port=...)`.
+  heartbeat age); started by `run_resilient(metrics_port=...)`; routes
+  may stream chunked responses (the live event feed).
+- `live` — the LIVE observability plane (ISSUE 18 tentpole):
+  `FlightTail` (byte-offset-checkpointed incremental tailing of flight
+  JSONLs, torn-line and gap tolerant), `LiveAggregate` (rolling derived
+  signals while jobs still run: step quantiles + robust z, deadline
+  slack, barrier-spread straggler attribution, byte rates, queue
+  pressure), and the declarative `AlertRule`/`AlertEngine` with
+  `default_rule_pack` and pluggable sinks (`log_sink`,
+  `ControlFileSink`, `WebhookSink`); served over HTTP by
+  `serve.ObservePlane` (``/v1/observe`` + ``/v1/events``) and embedded
+  in-process by `service.MeshScheduler(alerts=True)`.
 - `perfmodel` — the performance ORACLE (ISSUE 6 tentpole): `predict_step`
   combines the static halo wire plan, per-model stencil workloads, and a
   `MachineProfile` of measured coefficients into per-step compute/comm/
@@ -67,12 +78,16 @@ from .calibrate import calibrate_machine
 from .export import prometheus_snapshot
 from .hooks import account_halo_exchange, note_heartbeat, \
     note_runner_cache, observe_checkpoint
+from .live import (
+    AlertEngine, AlertRule, ControlFileSink, FlightTail, LiveAggregate,
+    WebhookSink, default_rule_pack, log_sink,
+)
 from .perfdb import metric_direction, perfdb_add, perfdb_check, perfdb_load
 from .perfmodel import (
     MachineProfile, PerfWatch, STEP_WORKLOADS, StepWorkload,
     default_machine_profile, hierarchical_machine_profile,
     load_machine_profile, predict_reshard,
-    predict_step, save_machine_profile,
+    predict_step, robust_z, save_machine_profile,
 )
 from .recorder import (
     FlightRecorder, flight_recorder, read_flight_events, record_event,
@@ -105,7 +120,10 @@ __all__ = [
     "metrics_server",
     "note_runner_cache", "account_halo_exchange", "observe_checkpoint",
     "note_heartbeat",
+    "FlightTail", "LiveAggregate", "AlertRule", "AlertEngine",
+    "default_rule_pack", "log_sink", "ControlFileSink", "WebhookSink",
     "MachineProfile", "StepWorkload", "STEP_WORKLOADS", "PerfWatch",
+    "robust_z",
     "default_machine_profile", "hierarchical_machine_profile",
     "load_machine_profile",
     "save_machine_profile", "predict_step", "predict_reshard",

@@ -137,59 +137,99 @@ def aggregate_flight(source, *, run_id: str | None = None) -> dict:
     return agg
 
 
+_RESUME_CHUNKS = 64  # barrier timestamps carried per process for alignment
+
+
 def aggregate_events(events, *, run_id: str | None = None,
+                     resume: dict | None = None,
                      _what: str = "aggregate_events") -> dict:
     """`aggregate_flight` for ALREADY-LOADED events: the same run-id
     selection, per-process seq validation, and clock alignment over an
     iterable of event dicts (however they were read or concatenated).
-    Returns the same record minus the ``files`` map."""
+    Returns the same record minus the ``files`` map.
+
+    ``resume`` makes it INCREMENTAL for tailers: pass the ``"resume"``
+    record of the previous call and an events batch holding only the
+    NEW records (e.g. from `read_flight_events(..., offset=)`). Seq
+    validation then requires each process's batch to be gapless from
+    its checkpointed next seq (not from 0), the wall anchors default to
+    the checkpointed ones (a ``recorder_open`` is only expected in the
+    first batch), and the barrier-offset medians are computed over the
+    checkpoint's carried chunk ends PLUS the batch's — so alignment
+    quality matches a full re-read without re-validating history. The
+    result's ``events`` hold only the aligned batch; its ``"resume"``
+    record feeds the next call. An EMPTY batch is valid with ``resume``
+    (returns no events, state carried through)."""
     raw = list(events)
-    rid = _pick_run_id(raw, run_id)
+    prior = resume or {}
+    rid = _pick_run_id(raw, run_id if run_id is not None
+                       else prior.get("run_id"))
     per_proc: dict = {}
     for e in raw:
         if rid is not None and e.get("run") != rid:
             continue
         per_proc.setdefault(int(e.get("proc", 0)), []).append(e)
-    if not per_proc:
+    if not per_proc and resume is None:
         raise InvalidArgumentError(f"{_what}: no events for run {rid!r}.")
 
-    # --- seq consistency: duplicate-free, gapless from 0 per process ----
+    # --- seq consistency: duplicate-free, gapless per process (from 0,
+    # or from the resume checkpoint's next expected seq) -----------------
+    next_seq = {int(p): int(n)
+                for p, n in (prior.get("next_seq") or {}).items()}
     per_process_meta = {}
     for proc, evs in per_proc.items():
+        base = next_seq.get(proc, 0)
         seqs = sorted(e["seq"] for e in evs if "seq" in e)
         if len(set(seqs)) != len(seqs):
             raise InvalidArgumentError(
                 f"{_what}: duplicate sequence numbers for process "
                 f"{proc} (run {rid!r}) — two writers interleaved one "
                 "stream.")
-        if seqs and seqs != list(range(len(seqs))):
+        if seqs and seqs != list(range(base, base + len(seqs))):
+            at = "do not start at 0" if base == 0 else \
+                f"do not resume at {base}"
             raise InvalidArgumentError(
                 f"{_what}: process {proc} (run {rid!r}) has gaps in its "
-                "sequence numbers (or they do not start at 0) — a stream "
+                f"sequence numbers (or they {at}) — a stream "
                 "file is missing, was truncated mid-run, or lost its head "
                 "(the recorder_open wall anchor).")
         evs.sort(key=lambda e: e.get("seq", 0))
+        if seqs:
+            next_seq[proc] = seqs[-1] + 1
         per_process_meta[proc] = {
             "events": len(evs),
             "chunks": sum(1 for e in evs if e.get("kind") == "chunk"),
         }
 
-    procs = sorted(per_proc)
-    anchor = procs[0]
-
     # --- clock alignment -------------------------------------------------
     # 1) per process: monotonic -> wall via the recorder_open anchor
-    wall_anchor = {}
+    #    (carried through resume once seen)
+    wall_anchor = {int(p): float(a)
+                   for p, a in (prior.get("wall_anchor") or {}).items()}
     for proc, evs in per_proc.items():
-        a = 0.0
         for e in evs:
             if e.get("kind") == "recorder_open" and "wall" in e:
-                a = float(e["wall"]) - float(e["t"])
+                wall_anchor[proc] = float(e["wall"]) - float(e["t"])
                 break
-        wall_anchor[proc] = a
+        wall_anchor.setdefault(proc, 0.0)
+    # union of every process ever seen: a process silent THIS batch keeps
+    # its alignment state (and its offset) across incremental calls
+    chunk_hist = {int(p): {int(c): float(t) for c, t in ends.items()}
+                  for p, ends in (prior.get("chunk_ends") or {}).items()}
+    procs = sorted(set(per_proc) | set(chunk_hist) | set(wall_anchor))
+    if not procs:
+        raise InvalidArgumentError(f"{_what}: no events for run {rid!r}.")
+    anchor = procs[0]
     # 2) residual offset to the anchor process: median delta of the
     #    chunk-barrier timestamps over the chunks both processes logged
-    ref_ends = _chunk_ends(per_proc[anchor])
+    #    (resume carries the trailing _RESUME_CHUNKS barriers per process)
+    for proc, evs in per_proc.items():
+        hist = chunk_hist.setdefault(proc, {})
+        hist.update(_chunk_ends(evs))
+        if len(hist) > _RESUME_CHUNKS:
+            for c in sorted(hist)[:len(hist) - _RESUME_CHUNKS]:
+                del hist[c]
+    ref_ends = chunk_hist.get(anchor, {})
     offsets = {anchor: 0.0}
     residuals = {anchor: 0.0}
     chunks_used = {anchor: len(ref_ends)}
@@ -197,7 +237,7 @@ def aggregate_events(events, *, run_id: str | None = None,
     # to its wall anchor must not misreport the healthy streams' quality
     methods = {anchor: "anchor"}
     for proc in procs[1:]:
-        ends = _chunk_ends(per_proc[proc])
+        ends = chunk_hist.get(proc, {})
         common = sorted(set(ends) & set(ref_ends))
         deltas = [(ends[c] + wall_anchor[proc])
                   - (ref_ends[c] + wall_anchor[anchor]) for c in common]
@@ -237,6 +277,11 @@ def aggregate_events(events, *, run_id: str | None = None,
                   "residual_s": residuals},
         "per_process": per_process_meta,
         "events": merged,
+        "resume": {"run_id": rid,
+                   "next_seq": dict(next_seq),
+                   "wall_anchor": dict(wall_anchor),
+                   "chunk_ends": {p: dict(h)
+                                  for p, h in chunk_hist.items()}},
     }
 
 

@@ -23,7 +23,8 @@ __all__ = ["note_runner_cache", "account_halo_exchange",
            "note_scheduler_heartbeat", "note_queue_depth", "job_gauges",
            "observe_job_slice", "clear_scheduler_heartbeat",
            "note_job_transition", "observe_member_health",
-           "observe_reshard"]
+           "observe_reshard", "note_deadline_slack", "note_queue_backlog",
+           "note_alert"]
 
 # Metric family names (the exported contract; see docs/observability.md).
 RUNNER_CACHE = "igg_runner_cache_total"
@@ -74,6 +75,13 @@ MEMBER_TRIPS = "igg_member_guard_trips_total"
 JOB_MEMBER_RMS = "igg_job_member_rms"
 JOB_MEMBER_NONFINITE = "igg_job_member_nonfinite_cells"
 JOB_MEMBER_TRIPS = "igg_job_member_guard_trips_total"
+# live observability plane (ISSUE 18): deadline slack, queue pressure,
+# alert transitions (scoped igg_job_* twin per the label-shape rule above)
+DEADLINE_SLACK = "igg_deadline_slack_seconds"
+JOB_DEADLINE_SLACK = "igg_job_deadline_slack_seconds"
+QUEUE_PENDING = "igg_queue_pending"
+QUEUE_OLDEST = "igg_queue_oldest_age_seconds"
+ALERTS_TOTAL = "igg_alerts_total"
 
 
 def runner_cache_misses() -> float:
@@ -337,6 +345,44 @@ def note_deadline_missed() -> None:
         ).inc(1)
 
 
+def note_deadline_slack(slack_s: float) -> None:
+    """Stamp the driver's live deadline slack (remaining budget minus the
+    priced cost of the remaining steps) — the signal the deadline-slack
+    burn alert and next arc's preemption policy subscribe to. One gauge
+    write per chunk boundary, only on deadline-budgeted runs."""
+    metrics_registry().gauge(
+        DEADLINE_SLACK,
+        "Remaining deadline budget minus predicted remaining work "
+        "(seconds; negative = provable bust).").set(slack_s)
+
+
+def note_queue_backlog(pending: int, oldest_age_s: float | None) -> None:
+    """Track the submission-queue BACKLOG (jobs filed on the queue
+    backend, not yet claimed by any scheduler — upstream of
+    `note_queue_depth`'s admitted-jobs gauges): pending count and the age
+    of the oldest unclaimed record, the queue-pressure pair the ROADMAP
+    autoscaler watches."""
+    reg = metrics_registry()
+    reg.gauge(QUEUE_PENDING,
+              "Unclaimed job records on the submission queue backend."
+              ).set(int(pending))
+    if oldest_age_s is not None:
+        reg.gauge(QUEUE_OLDEST,
+                  "Age of the oldest unclaimed queue record (seconds)."
+                  ).set(float(oldest_age_s))
+
+
+def note_alert(rule: str, severity: str, state: str) -> None:
+    """Count one alert state-machine transition
+    (``igg_alerts_total{rule,severity,state}``; ``state``: ``firing`` |
+    ``resolved``). The journal's ``alert`` event is the detailed twin."""
+    metrics_registry().counter(
+        ALERTS_TOTAL,
+        "Alert-engine state transitions by rule, severity, and new state.",
+        ("rule", "severity", "state")).inc(
+        1, rule=str(rule), severity=str(severity), state=str(state))
+
+
 def job_gauges(registry, job: str):
     """The per-job labeled families, as a `ScopedRegistry` view bound to
     one tenant — what `/metrics` serves across job lifetimes (step,
@@ -348,12 +394,17 @@ def job_gauges(registry, job: str):
 
 def observe_job_slice(scope, *, step, slice_s: float, wait_s: float,
                       perf_step_s=None, perf_ratio=None,
-                      audit_findings: float = 0.0) -> None:
+                      audit_findings: float = 0.0,
+                      slack_s=None) -> None:
     """Record one granted slice for one job into its scoped gauge view
     (`job_gauges`): committed step + heartbeat, slice/wait latency
     histograms, and the perf-oracle mirrors (the process-wide
     ``igg_perf_*`` gauges flap between tenants under multiplexing — the
-    per-job labeled copies are the ones an operator alerts on)."""
+    per-job labeled copies are the ones an operator alerts on).
+    ``slack_s`` mirrors the driver's live deadline slack into the
+    per-job label (same label-shape rule as the perf pair: the
+    process-wide ``igg_deadline_slack_seconds`` flaps between
+    tenants)."""
     scope.gauge(JOB_STEP, "Last step this job committed.").set(step)
     scope.gauge(JOB_HEARTBEAT_TS,
                 "Wall-clock time of this job's last granted slice "
@@ -376,6 +427,11 @@ def observe_job_slice(scope, *, step, slice_s: float, wait_s: float,
         scope.counter(JOB_AUDIT_FINDINGS,
                       "Static-analysis findings attributed to this job's "
                       "compile-time audits.").inc(audit_findings)
+    if slack_s is not None:
+        scope.gauge(JOB_DEADLINE_SLACK,
+                    "This job's remaining deadline budget minus predicted "
+                    "remaining work (seconds; negative = provable bust)."
+                    ).set(slack_s)
 
 
 def observe_member_health(reports, scope=None) -> None:

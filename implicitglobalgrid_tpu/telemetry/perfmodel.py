@@ -47,7 +47,7 @@ from ..utils.exceptions import InvalidArgumentError
 __all__ = ["MachineProfile", "StepWorkload", "STEP_WORKLOADS",
            "default_machine_profile", "hierarchical_machine_profile",
            "load_machine_profile", "save_machine_profile", "predict_step",
-           "predict_reshard", "PerfWatch"]
+           "predict_reshard", "PerfWatch", "robust_z"]
 
 _PROFILE_VERSION = 1
 
@@ -654,6 +654,28 @@ def _itemsize_of(f) -> int:
         return 4
 
 
+def robust_z(value: float, history, *, rel_floor: float = 0.02,
+             min_samples: int = 2) -> tuple:
+    """The house robust z-score: ``(z, median, mad)`` of ``value``
+    against ``history`` (an iterable of floats), with
+
+        z = (value - median) / max(1.4826 * MAD, rel_floor * median, 1e-12)
+
+    — the one estimator shared by `PerfWatch` (in-driver drift detection)
+    and `telemetry.live.LiveAggregate` (observer-side tailing), so the
+    two planes can never disagree on what counts as a regression. Returns
+    ``(None, None, None)`` before ``min_samples`` history entries."""
+    from statistics import median
+
+    hist = list(history)
+    if len(hist) < max(2, int(min_samples)):
+        return None, None, None
+    med = median(hist)
+    mad = median([abs(x - med) for x in hist])
+    sigma = max(1.4826 * mad, rel_floor * med, 1e-12)
+    return (float(value) - med) / sigma, med, mad
+
+
 class PerfWatch:
     """Live drift detector over per-chunk step times (host-side only).
 
@@ -691,23 +713,30 @@ class PerfWatch:
         self._hist: deque = deque(maxlen=self.window)
         self.regressions = 0
 
+    def baseline_s(self) -> float | None:
+        """The current warm per-step baseline (median of the rolling
+        window), or None before ``min_samples`` warm chunks — the
+        measured-price fallback the driver's deadline-slack computation
+        uses when no `predict_step` model was attached."""
+        from statistics import median
+
+        if len(self._hist) < self.min_samples:
+            return None
+        return float(median(self._hist))
+
     def observe(self, *, chunk, step_begin, step_end, n, exec_s,
                 cold: bool = False) -> dict | None:
         """One chunk boundary. Returns the regression record (or None)."""
-        from statistics import median
-
         from .hooks import observe_perf
 
         per_step = float(exec_s) / max(1, int(n))
         ratio = (per_step / self.model_step_s
                  if self.model_step_s else None)
-        z = None
+        z, med, mad = robust_z(per_step, self._hist,
+                               rel_floor=self.rel_floor,
+                               min_samples=self.min_samples)
         verdict = None
-        if len(self._hist) >= self.min_samples:
-            med = median(self._hist)
-            mad = median([abs(x - med) for x in self._hist])
-            sigma = max(1.4826 * mad, self.rel_floor * med, 1e-12)
-            z = (per_step - med) / sigma
+        if z is not None:
             if not cold and z > self.zmax:
                 self.regressions += 1
                 verdict = {"chunk": chunk, "step_begin": step_begin,

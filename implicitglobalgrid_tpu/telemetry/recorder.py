@@ -223,31 +223,81 @@ def record_span(kind: str, **fields):
         yield
 
 
-def read_flight_events(path, *, run_id: str | None = None) -> list:
+def read_flight_events(path, *, run_id: str | None = None,
+                       offset: int | None = None):
     """Parse a flight-recorder JSONL file back into a list of dicts, in
     file order.
 
     A malformed FINAL line is tolerated (a crash mid-write is exactly the
     scenario flight recorders exist for); a malformed interior line raises
     `InvalidArgumentError` (the file was edited or interleaved by a foreign
-    writer). ``run_id`` filters to one run's records."""
+    writer). ``run_id`` filters to one run's records.
+
+    ``offset`` switches to RESUMABLE mode for tailers (`telemetry.live`):
+    reading starts at that byte offset and the return value becomes
+    ``(events, new_offset)``, where ``new_offset`` is the position after
+    the last COMPLETE well-formed line consumed. A torn final line — no
+    trailing newline yet, or not yet parseable — is left unconsumed, so
+    the next poll re-reads it once the writer's flush completes; it only
+    becomes the fatal interior-corruption case when a later complete line
+    follows it. Pass ``offset=0`` for the first read and the returned
+    ``new_offset`` thereafter; the whole-file form (``offset=None``)
+    behaves exactly as before."""
     path = os.fspath(path)
     if not os.path.exists(path):
         raise InvalidArgumentError(f"Flight-recorder file not found: {path}")
+    if offset is None:
+        out = []
+        bad_at = None
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                if not line.strip():
+                    continue
+                if bad_at is not None:
+                    raise InvalidArgumentError(
+                        f"Flight-recorder file {path} has a malformed "
+                        f"interior line {bad_at + 1} — corrupt or foreign "
+                        "content.")
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    bad_at = i  # fatal only if any well-formed line follows
+        if run_id is not None:
+            out = [e for e in out if e.get("run") == str(run_id)]
+        return out
+
+    # resumable tail read: byte-offset bookkeeping in BINARY mode (text
+    # offsets are not seekable positions under utf-8)
+    pos = int(offset)
+    if pos < 0:
+        raise InvalidArgumentError(
+            f"read_flight_events offset must be >= 0; got {offset}.")
     out = []
-    bad_at = None
-    with open(path, encoding="utf-8") as f:
-        for i, line in enumerate(f):
-            if not line.strip():
-                continue
-            if bad_at is not None:
+    bad = None  # (byte_pos_of_line, reason) of a malformed COMPLETE line
+    with open(path, "rb") as f:
+        f.seek(pos)
+        while True:
+            line = f.readline()
+            if not line:
+                break
+            if not line.endswith(b"\n"):
+                break  # torn tail mid-write: re-read next poll
+            if bad is not None:
+                if not line.strip():
+                    pos += len(line)  # blank after the bad line: benign
+                    continue
                 raise InvalidArgumentError(
                     f"Flight-recorder file {path} has a malformed interior "
-                    f"line {bad_at + 1} — corrupt or foreign content.")
+                    f"line at byte {bad} — corrupt or foreign content.")
+            if not line.strip():
+                pos += len(line)
+                continue
             try:
-                out.append(json.loads(line))
+                out.append(json.loads(line.decode("utf-8")))
             except ValueError:
-                bad_at = i  # fatal only if any well-formed line follows
+                bad = pos  # fatal only if any well-formed line follows
+                continue
+            pos += len(line)
     if run_id is not None:
         out = [e for e in out if e.get("run") == str(run_id)]
-    return out
+    return out, pos

@@ -53,6 +53,11 @@ _SEQ_FIELDS = {
     "resize": ("via", "new_dims", "step", "dur_s", "rounds",
                "wire_bytes"),
     "tuned_stale": ("reason", "model"),
+    "deadline_slack": ("step", "slack_s", "budget_s", "priced_step_s",
+                       "priced_by", "remaining_steps"),
+    "deadline_missed": ("step", "deadline_s", "elapsed_s", "slack_s"),
+    "alert": ("rule", "severity", "state", "job", "signal", "value",
+              "threshold"),
     "run_end": ("completed", "chunks"),
 }
 
@@ -127,6 +132,35 @@ def _audit_section(audits: list, failures: list = ()) -> dict:
         out["failed_errors"] = [f.get("error") for f in failures]
         out["ok"] = False
     return out
+
+
+def _alerts_section(alerts: list) -> dict:
+    """The report's ``"alerts"`` block from the journaled ``alert``
+    transitions (`telemetry.live.AlertEngine` — scheduler-side
+    in-process evaluation): transition counts per rule, and the set
+    still FIRING at stream end (the last transition per (rule, job)
+    wins — a resolve clears it)."""
+    by_rule: dict = {}
+    active: dict = {}
+    for a in alerts:
+        rule = a.get("rule", "?")
+        rec = by_rule.setdefault(
+            rule, {"firing": 0, "resolved": 0,
+                   "severity": a.get("severity")})
+        state = a.get("state")
+        if state in rec:
+            rec[state] += 1
+        key = (rule, a.get("job"))
+        if state == "firing":
+            active[key] = {"rule": rule, "job": a.get("job"),
+                           "severity": a.get("severity"),
+                           "signal": a.get("signal"),
+                           "value": a.get("value"), "t": a.get("t")}
+        elif state == "resolved":
+            active.pop(key, None)
+    return {"transitions": len(alerts),
+            "by_rule": dict(sorted(by_rule.items())),
+            "active": list(active.values())}
 
 
 def _pick(ev: dict, fields: tuple) -> dict:
@@ -227,6 +261,7 @@ def run_report(source, *, run_id: str | None = None,
     trips, escalations, elastic, resizes = [], [], [], []
     perf_model, perf_regressions = None, []
     audits, audit_failures = [], []
+    alerts, slack_last, deadline_miss = [], None, None
     begin = end = None
     halo = {"exchanges": 0, "ppermutes": 0, "wire_bytes": 0}
     io = {"snapshots_submitted": 0, "snapshots_written": 0,
@@ -286,6 +321,12 @@ def run_report(source, *, run_id: str | None = None,
             perf_model = e
         elif k == "perf_regression":
             perf_regressions.append(e)
+        elif k == "alert":
+            alerts.append(e)
+        elif k == "deadline_slack":
+            slack_last = e
+        elif k == "deadline_missed":
+            deadline_miss = e
         elif k == "run_begin":
             begin = e
         elif k == "run_end":
@@ -340,6 +381,16 @@ def run_report(source, *, run_id: str | None = None,
         "io": io,
         "audit": _audit_section(audits, audit_failures),
         "perf": _perf_section(chunks, perf_model, perf_regressions),
+        "alerts": _alerts_section(alerts),
+        "deadline": {
+            "missed": deadline_miss is not None,
+            "missed_step": None if deadline_miss is None
+            else deadline_miss.get("step"),
+            "slack_s_last": None if slack_last is None
+            else slack_last.get("slack_s"),
+            "priced_by": None if slack_last is None
+            else slack_last.get("priced_by"),
+        },
         "sequence": sequence,
     }
     if mesh is not None:

@@ -53,7 +53,16 @@ class MetricsServer:
     (code, body_bytes, ctype[, headers_dict]) | None`` — ``query`` is
     the RAW query string, ``body`` the request bytes (b"" for GET);
     return None to 404. Route exceptions answer a JSON 500 (the server
-    thread must survive any handler bug)."""
+    thread must survive any handler bug).
+
+    A route may return an ITERATOR of bytes instead of a body — the
+    response then streams as HTTP/1.1 chunked transfer, one chunk per
+    yielded block, flushed immediately (the ``/v1/events`` live feed).
+    Exceptions raised while CREATING the iterator still 500 (raise them
+    inside ``routes``, or build the generator's first state eagerly);
+    once streaming began the status line is gone, so a mid-stream error
+    or a hung-up consumer just ends the stream — resumable consumers
+    re-request from their cursor."""
 
     def __init__(self, port: int = 0, *, host: str = "127.0.0.1",
                  registry=None, healthz_max_age_s: float | None = None,
@@ -68,6 +77,11 @@ class MetricsServer:
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
+            # chunked transfer (the streaming routes) needs HTTP/1.1;
+            # every fixed response carries Content-Length, so keep-alive
+            # stays correct for plain scrapes too
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):  # no stderr chatter per scrape
                 pass
 
@@ -80,6 +94,34 @@ class MetricsServer:
                     self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _stream(self, code: int, chunks, ctype: str,
+                        headers: dict | None = None) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Transfer-Encoding", "chunked")
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
+                self.end_headers()
+                try:
+                    for chunk in chunks:
+                        if not chunk:
+                            continue
+                        data = chunk if isinstance(chunk, bytes) \
+                            else str(chunk).encode("utf-8")
+                        self.wfile.write(b"%x\r\n" % len(data)
+                                         + data + b"\r\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except (ConnectionError, OSError):
+                    # the consumer hung up mid-stream — its seq cursor
+                    # resumes it; nothing to answer on a dead socket
+                    self.close_connection = True
+                except Exception:
+                    # a generator bug after the status line went out:
+                    # end the stream (the consumer sees truncation and
+                    # re-requests); the server thread survives
+                    self.close_connection = True
 
             def _route(self, method: str, body: bytes) -> None:
                 path, _, query = self.path.partition("?")
@@ -101,7 +143,10 @@ class MetricsServer:
                     return
                 code, payload, ctype = resp[0], resp[1], resp[2]
                 headers = resp[3] if len(resp) > 3 else None
-                self._send(int(code), payload, ctype, headers)
+                if isinstance(payload, (bytes, bytearray)):
+                    self._send(int(code), bytes(payload), ctype, headers)
+                else:
+                    self._stream(int(code), payload, ctype, headers)
 
             def do_GET(self):
                 path = self.path.split("?", 1)[0]

@@ -38,7 +38,7 @@ __all__ = ["export_chrome_trace"]
 # renders them at: process-wide bars.
 _INSTANTS = ("guard_trip", "rollback", "escalation", "elastic_restart",
              "fault_injected", "snapshot_drop", "snapshot_error",
-             "perf_regression", "tuned_stale")
+             "perf_regression", "tuned_stale", "deadline_missed")
 
 _TID_DRIVER = 0
 _TID_IO = 1
@@ -219,6 +219,22 @@ def _emit_event(trace: list, e: dict, p: int, us, wire_cum: dict) -> None:
                 trace.append({"ph": "C", "pid": p,
                               "name": "igg_io_queue_depth", "ts": us(t),
                               "args": {"depth": e["queue_depth"]}})
+        elif kind == "alert":
+            # an alert transition (live plane): a named red flag so the
+            # rule and new state read straight off the timeline
+            trace.append({"ph": "i", "pid": p, "tid": _TID_DRIVER,
+                          "cat": "alert",
+                          "name": f"alert {e.get('rule')} "
+                                  f"{e.get('state')}",
+                          "ts": us(t), "s": "p", "args": _args(e)})
+        elif kind == "deadline_slack":
+            # the slack trajectory as a counter track — the burn an
+            # operator eyeballs next to the deadline_missed instant
+            if e.get("slack_s") is not None:
+                trace.append({"ph": "C", "pid": p,
+                              "name": "igg_deadline_slack_seconds",
+                              "ts": us(t),
+                              "args": {"s": float(e["slack_s"])}})
         elif kind in _INSTANTS:
             trace.append({"ph": "i", "pid": p, "tid": _TID_DRIVER,
                           "cat": "event", "name": kind, "ts": us(t),

@@ -242,6 +242,18 @@ def _cli(argv=None) -> int:
       imbalance report (`telemetry.straggler_report`): per-chunk
       barrier-arrival spreads, slowest-process attribution, persistent-
       straggler flags, wait/compute imbalance.
+    - ``watch <flight_dir>`` — the LIVE terminal dashboard
+      (`telemetry.LiveAggregate`, docs/observability.md "Live plane"):
+      tails the directory's flight streams incrementally and redraws a
+      per-job table (state, step, warm p50/p90 step time, robust z,
+      deadline slack, guard trips, snapshot queue) plus active alerts
+      every ``--interval`` seconds; ``--once`` polls and prints a single
+      frame (scripts/tests), ``--json`` emits the raw snapshot instead.
+    - ``alerts <flight_dir>`` — list the alert transitions journaled in
+      a flight directory (rule, severity, state, job, when) with their
+      ack state; ``--ack RULE[:JOB]`` acknowledges an alert in the
+      side file ``alerts_ack.json`` (journals are append-only and
+      seq-validated — acks never touch them).
     - ``perfdb add <bench.json> --db HISTORY.jsonl`` — append a bench
       run (BENCH_ALL.json shape) to the perf-history database;
       ``perfdb check <bench.json> --db HISTORY.jsonl`` gates it against
@@ -416,6 +428,32 @@ def _cli(argv=None) -> int:
     stp.add_argument("--share", type=float, default=0.5,
                      help="slowest-share above which a window flags")
     stp.add_argument("--indent", type=int, default=2)
+    wp = sub.add_parser(
+        "watch", help="live terminal dashboard over a flight directory "
+                      "(incremental tail, rolling derived signals, "
+                      "active alerts)")
+    wp.add_argument("flight_dir",
+                    help="directory of per-run flight JSONLs (a live "
+                         "run's flight dir or a scheduler's service dir)")
+    wp.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between polls/redraws")
+    wp.add_argument("--window", type=int, default=16,
+                    help="rolling window (boundaries) for the derived "
+                         "signals")
+    wp.add_argument("--once", action="store_true",
+                    help="poll once, print one frame, exit (no screen "
+                         "clear — the scripting/test mode)")
+    wp.add_argument("--json", action="store_true",
+                    help="emit the raw snapshot JSON instead of the "
+                         "table")
+    al = sub.add_parser(
+        "alerts", help="list journaled alert transitions of a flight "
+                       "directory; acknowledge with --ack")
+    al.add_argument("flight_dir")
+    al.add_argument("--ack", default=None, metavar="RULE[:JOB]",
+                    help="acknowledge an alert (recorded in the side "
+                         "file alerts_ack.json, never in the journal)")
+    al.add_argument("--json", action="store_true")
     pdb = sub.add_parser(
         "perfdb", help="perf-history database: append bench runs, gate "
                        "regressions vs the trailing window")
@@ -629,6 +667,10 @@ def _cli(argv=None) -> int:
         return _cli_jobs(args)
     if args.cmd == "tune":
         return _cli_tune(args)
+    if args.cmd == "watch":
+        return _cli_watch(args)
+    if args.cmd == "alerts":
+        return _cli_alerts(args)
 
     from .telemetry import prometheus_snapshot, run_report
 
@@ -775,6 +817,167 @@ def _cli(argv=None) -> int:
     rep = run_report(args.jsonl, run_id=args.run_id, trace_dir=args.trace,
                      include_metrics=not args.no_metrics)
     print(json.dumps(rep, indent=args.indent, default=str))
+    return 0
+
+
+def _fmt_s(v, unit="s") -> str:
+    if v is None:
+        return "-"
+    return f"{float(v):.3g}{unit}"
+
+
+def _render_watch(snap: dict) -> str:
+    """One dashboard frame from a `LiveAggregate.snapshot()`. Pure
+    string-building (stdlib only) so tests can assert on a frame without
+    a terminal."""
+    lines = []
+    q = snap.get("queue") or {}
+    sched = snap.get("scheduler") or {}
+    hdr = f"igg watch  cursor={snap.get('cursor')}"
+    if sched:
+        hdr += (f"  scheduler[slices={sched.get('slices')}"
+                f" draining={sched.get('draining')}]")
+    if q and "pending" in q:
+        hdr += (f"  queue[pending={q.get('pending')}"
+                f" oldest={_fmt_s(q.get('oldest_age_s'))}]")
+    gaps = snap.get("gaps") or []
+    if gaps:
+        hdr += f"  gaps={len(gaps)}"
+    lines.append(hdr)
+    jobs = snap.get("jobs") or {}
+    if jobs:
+        lines.append(f"{'JOB':<16} {'STATE':<9} {'STEP':>11} "
+                     f"{'P50':>8} {'P90':>8} {'Z':>6} {'SLACK':>8} "
+                     f"{'TRIPS':>5} {'QD':>3} {'DROP':>4}")
+        for name in sorted(jobs):
+            j = jobs[name]
+            nt = j.get("nt")
+            step = f"{j.get('step', 0)}/{nt}" if nt else str(
+                j.get("step", 0))
+            z = j.get("z")
+            lines.append(
+                f"{name[:16]:<16} {str(j.get('state', '?'))[:9]:<9} "
+                f"{step:>11} {_fmt_s(j.get('step_s_p50')):>8} "
+                f"{_fmt_s(j.get('step_s_p90')):>8} "
+                f"{('-' if z is None else f'{z:+.1f}'):>6} "
+                f"{_fmt_s(j.get('deadline_slack_s')):>8} "
+                f"{j.get('guard_trips', 0):>5} "
+                f"{j.get('snapshot_queue_depth', 0) or 0:>3} "
+                f"{j.get('snapshot_drops', 0):>4}")
+    else:
+        lines.append("(no jobs yet)")
+    procs = snap.get("procs") or {}
+    shares = {p: r.get("slowest_share") for p, r in procs.items()
+              if r.get("slowest_share") is not None}
+    if shares:
+        lines.append("stragglers: " + "  ".join(
+            f"p{p}={shares[p]:.0%}" for p in sorted(shares)))
+    alerts = snap.get("alerts") or {}
+    for a in alerts.get("active") or []:
+        lines.append(
+            f"ALERT {a.get('severity', '?').upper():<8} "
+            f"{a.get('rule')}  job={a.get('job') or '-'}  "
+            f"value={a.get('value')}")
+    return "\n".join(lines) + "\n"
+
+
+def _cli_watch(args) -> int:
+    """The ``watch`` subcommand: a live terminal dashboard. Each tick
+    polls the incremental tailer (byte offsets carry over — each redraw
+    reads only what the run appended since the last one) and redraws."""
+    import json
+    import sys
+    import time
+
+    from .telemetry.live import LiveAggregate
+
+    agg = LiveAggregate(args.flight_dir, window=args.window)
+    try:
+        while True:
+            agg.poll()
+            snap = agg.snapshot()
+            if args.json:
+                print(json.dumps(snap, default=str))
+            else:
+                frame = _render_watch(snap)
+                if not args.once:
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                sys.stdout.write(frame)
+                sys.stdout.flush()
+            if args.once:
+                return 0
+            time.sleep(max(0.05, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+def _cli_alerts(args) -> int:
+    """The ``alerts`` subcommand: list the alert transitions journaled
+    in a flight directory's streams, folded to current per-(rule, job)
+    state, with ack bookkeeping in the SIDE file ``alerts_ack.json`` —
+    flight journals are append-only and seq-validated, so acks must
+    never touch them."""
+    import glob as _glob
+    import json
+    import os
+    import time
+
+    from .telemetry.recorder import read_flight_events
+    from .utils.exceptions import InvalidArgumentError
+
+    transitions = []
+    for p in sorted(_glob.glob(os.path.join(args.flight_dir, "*.jsonl"))):
+        try:
+            evs, _off = read_flight_events(p, offset=0)
+        except InvalidArgumentError:
+            continue
+        transitions.extend(e for e in evs if e.get("kind") == "alert")
+    transitions.sort(key=lambda e: float(e.get("t", 0.0)))
+
+    ack_path = os.path.join(args.flight_dir, "alerts_ack.json")
+    acks = {}
+    if os.path.exists(ack_path):
+        with open(ack_path, encoding="utf-8") as f:
+            acks = json.load(f)
+    if args.ack:
+        rule, _, job = args.ack.partition(":")
+        key = f"{rule}|{job}"
+        acks[key] = {"t": time.time()}
+        tmp = ack_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(acks, f, indent=2)
+        os.replace(tmp, ack_path)
+
+    # fold to current state per (rule, job): the LAST transition wins
+    current: dict = {}
+    for e in transitions:
+        current[(e.get("rule"), e.get("job") or "")] = e
+    rows = []
+    for (rule, job), e in sorted(current.items()):
+        key = f"{rule}|{job}"
+        rows.append({"rule": rule, "job": job or None,
+                     "state": e.get("state"),
+                     "severity": e.get("severity"),
+                     "value": e.get("value"), "t": e.get("t"),
+                     "acked": key in acks,
+                     "transitions": sum(
+                         1 for x in transitions
+                         if x.get("rule") == rule
+                         and (x.get("job") or "") == job)})
+    if args.json:
+        print(json.dumps({"alerts": rows,
+                          "transitions": len(transitions)}, default=str))
+        return 0
+    if not rows:
+        print("no alerts journaled")
+        return 0
+    print(f"{'RULE':<26} {'JOB':<12} {'STATE':<9} {'SEV':<9} "
+          f"{'N':>3} {'ACK':<3}")
+    for r in rows:
+        print(f"{str(r['rule'])[:26]:<26} "
+              f"{str(r['job'] or '-')[:12]:<12} "
+              f"{str(r['state'])[:9]:<9} {str(r['severity'])[:9]:<9} "
+              f"{r['transitions']:>3} {'yes' if r['acked'] else 'no':<3}")
     return 0
 
 

@@ -831,6 +831,81 @@ def test_telemetry_leaves_chunk_program_untouched(tmp_path):
     assert len(out_on) == len(out_off) == 3  # T, Cp, stats vector
 
 
+def test_live_plane_leaves_chunk_program_untouched(tmp_path):
+    """THE ISSUE-18 wire claim: the live observability plane is pure
+    host-side tailing — building the guarded chunk runner while a
+    flight recorder streams, a `LiveAggregate` incrementally tails the
+    same file between chunks, an `AlertEngine` (default rule pack)
+    evaluates every snapshot, and an `ObserveServer` answers
+    ``/v1/observe`` + ``/v1/events`` over HTTP mid-run yields a program
+    with identical collective counts and an identical fetch surface as
+    with the plane off. Zero extra collectives, zero extra D2H fetches
+    per chunk — the tail reads bytes from disk, never the device."""
+    import json as _json
+    import urllib.request
+
+    from implicitglobalgrid_tpu.models import (
+        diffusion_step_local, init_diffusion3d,
+    )
+    from implicitglobalgrid_tpu.runtime.health import make_guarded_runner
+    from implicitglobalgrid_tpu.serve import ObserveServer
+    from implicitglobalgrid_tpu.telemetry import (
+        record_event, start_flight_recorder, stop_flight_recorder,
+    )
+    from implicitglobalgrid_tpu.telemetry.live import (
+        AlertEngine, LiveAggregate,
+    )
+
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    T, Cp, p = init_diffusion3d(dtype=np.float32)
+
+    def step(s):
+        return (diffusion_step_local(s[0], s[1], p, "xla"), s[1])
+
+    off = make_guarded_runner(step, (3, 3), nt_chunk=4,
+                              key="hlo_live_off")
+    ir_off = parse_program(off, T, Cp)
+
+    jsonl = tmp_path / "flight_live.jsonl"
+    start_flight_recorder(str(jsonl))
+    live = LiveAggregate(str(jsonl))
+    engine = AlertEngine()  # the default pack, observer-side
+    try:
+        with ObserveServer(str(tmp_path)) as obs:
+            u = f"http://{obs.host}:{obs.port}"
+            for i in range(3):  # the plane tails BETWEEN chunks
+                record_event("chunk", chunk=i, step_begin=4 * i,
+                             step_end=4 * i + 4, n=4, ok=True,
+                             exec_s=0.01)
+                live.poll()
+                engine.evaluate(live.snapshot())
+                with urllib.request.urlopen(u + "/v1/observe",
+                                            timeout=10) as r:
+                    _json.loads(r.read())
+            on = make_guarded_runner(step, (3, 3), nt_chunk=4,
+                                     key="hlo_live_on")
+            ir_on = parse_program(on, T, Cp)
+            out_on = on(T, Cp)
+            with urllib.request.urlopen(
+                    u + "/v1/events?since=-1&timeout_s=0.1",
+                    timeout=10) as r:
+                lines = [_json.loads(x) for x in r.read().splitlines()]
+            assert any(e["kind"] == "chunk" for e in lines)
+    finally:
+        stop_flight_recorder()
+    out_off = off(T, Cp)
+
+    assert len(ir_on.permutes) == len(ir_off.permutes)
+    assert len(ir_on.all_reduces) == len(ir_off.all_reduces) == 1
+    assert not ir_on.all_gathers and not ir_on.all_to_alls
+    # identical fetch surface: same program inputs and outputs
+    assert len(ir_on.parameters()) == len(ir_off.parameters())
+    for op in ("infeed", "outfeed"):
+        assert ir_on.count(op) == ir_off.count(op) == 0
+    assert len(out_on) == len(out_off) == 3  # T, Cp, stats vector
+
+
 def test_reducers_share_the_guard_psum():
     """THE io wire claim (ISSUE 4): an enabled in-situ reducer set adds
     ZERO extra collectives to the chunk program — probe, axis slice and

@@ -1,0 +1,616 @@
+"""Live observability plane tests (ISSUE 18): incremental flight
+tailing (byte-offset checkpoints, torn-line/truncation/seq-gap
+tolerance), `LiveAggregate`'s rolling derived signals (incremental ==
+one-shot), the declarative `AlertRule`/`AlertEngine` (every kind,
+hysteresis, wildcard fan-out, metric signals, ``igg_alerts_total``),
+the pluggable sinks (control-file, webhook against a real local
+endpoint, error containment), the `MetricsServer` ``routes=`` error
+paths + chunked streaming (the PR's satellite), and the ``tools
+watch``/``tools alerts`` CLI.
+
+Everything here is HOST-ONLY synthetics (exact ground truth, no grid,
+no accelerator); the end-to-end alert-driven cancellation under a live
+scheduler rides tests/test_serve.py."""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.telemetry.live import (
+    AlertEngine, AlertRule, ControlFileSink, FlightTail, LiveAggregate,
+    WebhookSink, default_rule_pack,
+)
+from implicitglobalgrid_tpu.telemetry.server import MetricsServer
+from implicitglobalgrid_tpu.utils.exceptions import InvalidArgumentError
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    igg.stop_flight_recorder()
+    igg.stop_metrics_server()
+    igg.reset_metrics()
+    yield
+    igg.stop_flight_recorder()
+    igg.stop_metrics_server()
+    igg.reset_metrics()
+
+
+# ---------------------------------------------------------------------------
+# Synthetic streams (appendable — the tail's whole point)
+# ---------------------------------------------------------------------------
+
+class _Stream:
+    """One flight JSONL written record-by-record, so tests control
+    exactly what is on disk between polls."""
+
+    def __init__(self, path, run_id, *, proc=0, wall0=5000.0,
+                 clock0=100.0):
+        self.path = str(path)
+        self.run = run_id
+        self.proc = proc
+        self.seq = 0
+        self.t = clock0
+        self.append("recorder_open", wall=wall0, version=1)
+
+    def append(self, kind, *, dt=0.0, raw=None, seq=None, **kw):
+        self.t += dt
+        rec = {"t": self.t, "kind": kind, "run": self.run, "pid": 1,
+               "proc": self.proc,
+               "seq": self.seq if seq is None else seq, **kw}
+        self.seq = rec["seq"] + 1
+        with open(self.path, "a") as f:
+            f.write((json.dumps(rec) if raw is None else raw) + "\n")
+        return rec
+
+    def chunk(self, c, *, n=4, exec_s=0.4, ok=True, dt=0.5, **kw):
+        return self.append("chunk", dt=dt, chunk=c, step_begin=c * n,
+                           step_end=(c + 1) * n, n=n, ok=ok, reasons=[],
+                           build_s=0.01, exec_s=exec_s, **kw)
+
+
+# ---------------------------------------------------------------------------
+# FlightTail
+# ---------------------------------------------------------------------------
+
+def test_tail_incremental_offsets_and_new_files(tmp_path):
+    """Polls return only what was appended since the last poll, and a
+    file created between polls joins the tail (the scheduler admitting a
+    new job mid-flight)."""
+    d = str(tmp_path)
+    s = _Stream(os.path.join(d, "job_a.jsonl"), "a")
+    s.append("run_begin", nt=8)
+    tail = FlightTail(d)
+    first = tail.poll()
+    assert [e["kind"] for e in first] == ["recorder_open", "run_begin"]
+    assert all(e["_file"].endswith("job_a.jsonl") for e in first)
+    assert tail.poll() == []  # nothing new
+    s.chunk(0)
+    s2 = _Stream(os.path.join(d, "job_b.jsonl"), "b")
+    more = tail.poll()
+    assert {(e["run"], e["kind"]) for e in more} == {
+        ("a", "chunk"), ("b", "recorder_open")}
+    assert tail.gaps == [] and tail.events_read == 4
+    assert s2.seq == 1  # the new stream really was fresh
+
+
+def test_tail_torn_final_line_reread_next_poll(tmp_path):
+    """A torn (partial) final line is NOT consumed — the offset stays
+    before it, and the completed record arrives on a later poll intact
+    (no gap recorded: tearing is the normal case mid-write)."""
+    p = str(tmp_path / "job_a.jsonl")
+    s = _Stream(p, "a")
+    tail = FlightTail(p)
+    assert len(tail.poll()) == 1
+    # a torn write: half a record, no newline
+    rec = {"t": s.t + 1, "kind": "chunk", "run": "a", "pid": 1,
+           "proc": 0, "seq": 1, "chunk": 0}
+    line = json.dumps(rec)
+    with open(p, "a") as f:
+        f.write(line[:13])
+    assert tail.poll() == []
+    assert tail.gaps == []
+    with open(p, "a") as f:
+        f.write(line[13:] + "\n")
+    evs = tail.poll()
+    assert [e["seq"] for e in evs] == [1] and evs[0]["chunk"] == 0
+    assert tail.gaps == []
+
+
+def test_tail_truncation_and_seq_gap_are_observations(tmp_path):
+    """A shrunk file restarts from its head with a ``truncated`` gap; a
+    sequence jump records a ``seq_gap``; neither raises and the tail
+    keeps following."""
+    p = str(tmp_path / "job_a.jsonl")
+    s = _Stream(p, "a")
+    s.chunk(0)
+    tail = FlightTail(p)
+    assert len(tail.poll()) == 2
+    # replace the file with a shorter one (rotation/rewrite)
+    os.truncate(p, 0)
+    s.seq = 0
+    s.append("recorder_open", wall=6000.0)
+    evs = tail.poll()
+    assert [e["kind"] for e in evs] == ["recorder_open"]
+    assert [g["kind"] for g in tail.gaps] == ["truncated"]
+    # drop seq 1-2: the hole is recorded, the event still delivered
+    s.append("chunk", seq=3, chunk=3, n=4, ok=True, exec_s=0.1)
+    evs = tail.poll()
+    assert [e["seq"] for e in evs] == [3]
+    assert [g["kind"] for g in tail.gaps] == ["truncated", "seq_gap"]
+    assert tail.gaps[-1] == {
+        "file": p, "run": "a", "proc": 0, "kind": "seq_gap",
+        "expected": 1, "got": 3, "t": tail.gaps[-1]["t"]}
+
+
+def test_tail_corrupt_interior_skips_file_not_tail(tmp_path):
+    """Interior corruption (invalid JSON with a complete line after it —
+    a torn line would just be re-read) records one ``corrupt`` gap and
+    skips that file to its end; other streams are unaffected and the bad
+    file resumes from later appends."""
+    d = str(tmp_path)
+    s = _Stream(os.path.join(d, "job_a.jsonl"), "a")
+    with open(s.path, "a") as f:
+        f.write("{not json}\n")
+    s.append("chunk", chunk=0, n=4, ok=True, exec_s=0.1)
+    b = _Stream(os.path.join(d, "job_b.jsonl"), "b")
+    tail = FlightTail(d)
+    evs = tail.poll()
+    assert {e["run"] for e in evs} == {"b"}
+    assert [g["kind"] for g in tail.gaps] == ["corrupt"]
+    s.append("chunk", chunk=1, n=4, ok=True, exec_s=0.1)
+    evs = tail.poll()
+    assert [(e["run"], e["chunk"]) for e in evs] == [("a", 1)]
+    assert b.seq == 1
+
+
+# ---------------------------------------------------------------------------
+# LiveAggregate: derived signals
+# ---------------------------------------------------------------------------
+
+def test_live_aggregate_derived_signals_and_incremental_equivalence(
+        tmp_path):
+    """The rolling per-job signals (quantiles, z, slack, counters,
+    rates) from a single-run stream — polled incrementally after every
+    append — match the one-shot read of the finished file."""
+    def _drive(agg, stream_ops):
+        for op in stream_ops:
+            op()
+            agg.poll()
+        return agg.snapshot()
+
+    def _ops(path):
+        s = _Stream(path, "a")
+        ops = [lambda: s.append("run_begin", nt=32, nt_chunk=4)]
+        for c in range(6):
+            ex = 0.4 if c < 5 else 4.0   # the last chunk is 10x slower
+            ops.append(lambda c=c, ex=ex: s.chunk(c, exec_s=ex))
+        ops += [
+            lambda: s.append("checkpoint_save", op="save", dur_s=0.2),
+            lambda: s.append("snapshot_write", step=20, nbytes=1000,
+                             queue_depth=2, dur_s=0.01, dt=1.0),
+            lambda: s.append("snapshot_write", step=24, nbytes=3000,
+                             queue_depth=1, dur_s=0.01, dt=1.0),
+            lambda: s.append("snapshot_drop", step=28, queue_depth=4),
+            lambda: s.append("deadline_slack", step=24, slack_s=3.5,
+                             budget_s=10.0, priced_step_s=0.1,
+                             priced_by="measured", remaining_steps=8),
+            lambda: s.append("run_end", completed=32, chunks=6),
+        ]
+        return ops
+
+    inc = LiveAggregate(str(tmp_path / "inc.jsonl"), window=8,
+                        min_samples=4)
+    snap = _drive(inc, _ops(str(tmp_path / "inc.jsonl")))
+    oneshot = LiveAggregate(str(tmp_path / "one.jsonl"), window=8,
+                            min_samples=4)
+    for op in _ops(str(tmp_path / "one.jsonl")):
+        op()
+    oneshot.poll()
+
+    j = snap["jobs"]["a"]
+    assert j["state"] == "done" and j["nt"] == 32
+    assert j["chunks"] == 6 and j["step"] == 24
+    assert j["step_s_last"] == pytest.approx(1.0)   # 4.0 / 4
+    assert j["step_s_p50"] == pytest.approx(0.1)
+    assert j["step_s_p90"] == pytest.approx(1.0)
+    # the blowout chunk against the warm window: a huge robust z
+    assert j["z"] is not None and j["z"] > 10
+    assert j["deadline_slack_s"] == 3.5 and j["deadline_budget_s"] == 10
+    assert j["checkpoint_s"] == pytest.approx(0.2)
+    assert j["snapshot_drops"] == 1 and j["snapshot_queue_depth"] == 4
+    assert j["snapshot_bytes_total"] == 4000
+    assert j["snapshot_bytes_rate"] == pytest.approx(3000.0)  # 3000B/1s
+    assert snap["cursor"] == 13  # 14 merged events, zero-based
+    # incremental == one-shot (timestamps aside)
+    s2 = oneshot.snapshot()
+    for k in ("jobs", "procs", "queue", "gaps"):
+        assert snap[k] == s2[k], k
+    # the merged feed is resumable by cursor
+    evs, cur = inc.events_since(5)
+    assert [e["live_seq"] for e in evs] == list(range(6, 14))
+    assert cur == 13
+    assert inc.events_since(cur) == ([], cur)
+
+
+def test_live_aggregate_two_proc_alignment_and_straggler(tmp_path):
+    """Two processes with wildly different monotonic origins and a
+    known wall skew: the incremental aligner merges them onto one
+    clock, and the barrier-spread window attributes the persistent
+    straggler (proc 1, late every chunk)."""
+    d = str(tmp_path)
+    a = _Stream(os.path.join(d, "flight_p0.jsonl"), "r", proc=0,
+                wall0=5000.0, clock0=1000.0)
+    b = _Stream(os.path.join(d, "flight_p1.jsonl"), "r", proc=1,
+                wall0=5000.25, clock0=987654.0)
+    agg = LiveAggregate(d, straggler_window=4)
+    for c in range(5):
+        # barrier-consistent schedule: proc 1 dispatches 0.05s late, so
+        # its exec_s is 0.05 shorter against the same barrier release
+        a.chunk(c, dt=0.55, exec_s=0.55)
+        b.chunk(c, dt=0.55, exec_s=0.50)
+        agg.poll()
+    snap = agg.snapshot()
+    assert snap["gaps"] == []
+    # alignment metadata recovered the skew for the run
+    assert snap["align"]["r"]["anchor_proc"] == 0
+    # proc 1 is the slowest arriver at (almost) every observed barrier
+    assert snap["procs"][1]["slowest_share"] > 0.6
+    assert snap["procs"][0]["slowest_share"] < 0.5
+    # merged feed is clock-ordered across both files
+    evs, _ = agg.events_since(None)
+    ts = [e["t"] for e in evs]
+    assert ts == sorted(ts)
+
+
+def test_live_aggregate_mid_stream_attach_degrades_not_raises(tmp_path):
+    """Attaching to a stream that already lost its head (first seen seq
+    > 0, no recorder_open wall anchor) still tails: events merge via the
+    shift-only fallback and the integrity observation is recorded."""
+    p = str(tmp_path / "job_a.jsonl")
+    s = _Stream(p, "a")
+    for c in range(3):
+        s.chunk(c)
+    # a consumer that starts late: simulate by pre-consuming the file
+    # head into a different tail, then truncating the head away
+    with open(p) as f:
+        lines = f.readlines()
+    with open(p, "w") as f:
+        f.writelines(lines[2:])   # recorder_open + run? gone
+    agg = LiveAggregate(p)
+    evs = agg.poll()
+    assert [e["kind"] for e in evs] == ["chunk", "chunk"]
+    assert agg.snapshot()["jobs"]["a"]["chunks"] == 2
+    s.chunk(3)
+    assert [e["chunk"] for e in agg.poll()] == [3]
+
+
+def test_live_aggregate_scheduler_journal_and_queue_pressure(tmp_path):
+    """The scheduler journal drives job states, slice counts, slack
+    mirrors, and alert records; a `DirectoryBackend` adds live
+    pending/oldest-age queue pressure."""
+    from implicitglobalgrid_tpu.service import DirectoryBackend
+
+    d = str(tmp_path)
+    backend = DirectoryBackend(d)
+    backend.submit({"name": "queued1", "model": "diffusion3d", "nt": 4})
+    s = _Stream(os.path.join(d, "scheduler.jsonl"), "scheduler")
+    s.append("scheduler_start", policy="fifo")
+    s.append("job_submitted", job="a", nt=8, priority=1)
+    s.append("job_admitted", job="a")
+    s.append("slice", job="a", slice=0, step=4, dur_s=0.4, wait_s=0.0,
+             policy="fifo", slack_s=2.5)
+    s.append("alert", rule="guard_trip_storm", severity="critical",
+             state="firing", job="a", signal="jobs.*.guard_trips",
+             value=1.0, threshold=1.0)
+    s.append("alert", rule="guard_trip_storm", severity="critical",
+             state="resolved", job="a", signal="jobs.*.guard_trips",
+             value=1.0, threshold=1.0)
+    s.append("job_done", job="a")
+    agg = LiveAggregate(d, backend=backend)
+    agg.poll()
+    snap = agg.snapshot()
+    j = snap["jobs"]["a"]
+    assert j["state"] == "done" and j["slices"] == 1
+    assert j["step"] == 4 and j["deadline_slack_s"] == 2.5
+    assert snap["scheduler"]["slices"] == 1
+    assert snap["queue"]["pending"] == 1
+    assert snap["queue"]["oldest_age_s"] >= 0
+    # the resolved transition cleared the active set; both are recent
+    assert snap["alerts"]["active"] == []
+    assert [a["state"] for a in snap["alerts"]["recent"]] == [
+        "firing", "resolved"]
+
+
+# ---------------------------------------------------------------------------
+# AlertRule / AlertEngine
+# ---------------------------------------------------------------------------
+
+def test_alert_rule_validation():
+    with pytest.raises(InvalidArgumentError, match="kind"):
+        AlertRule("r", "jobs.*.z", kind="nope")
+    with pytest.raises(InvalidArgumentError, match="op"):
+        AlertRule("r", "jobs.*.z", op="~")
+    with pytest.raises(InvalidArgumentError, match="wildcard"):
+        AlertRule("r", "jobs.*.sub.*.z")
+    with pytest.raises(InvalidArgumentError, match="name"):
+        AlertRule("", "jobs.*.z")
+    with pytest.raises(InvalidArgumentError, match=">= 1"):
+        AlertRule("r", "jobs.*.z", for_count=0)
+    with pytest.raises(InvalidArgumentError, match="duplicate"):
+        AlertEngine([AlertRule("r", "a"), AlertRule("r", "b")])
+    with pytest.raises(InvalidArgumentError, match="AlertRule"):
+        AlertEngine(["not a rule"])
+    pack = default_rule_pack()
+    assert len(pack) == 6
+    assert len({r.name for r in pack}) == 6
+
+
+def _snap(t, **jobs):
+    return {"t": t, "jobs": jobs, "procs": {}, "queue": {},
+            "scheduler": {}}
+
+
+def test_threshold_hysteresis_fire_and_resolve():
+    """for_count consecutive breaches fire; resolve_count consecutive
+    clears resolve; flapping below either count transitions nothing."""
+    eng = AlertEngine([AlertRule("hot", "jobs.*.z", op=">",
+                                 threshold=3.0, for_count=2,
+                                 resolve_count=2)])
+    assert eng.evaluate(_snap(1, a={"z": 5.0})) == []     # breach 1/2
+    trs = eng.evaluate(_snap(2, a={"z": 6.0}))            # fires
+    assert [(t["state"], t["job"]) for t in trs] == [("firing", "a")]
+    assert eng.active()[0]["rule"] == "hot"
+    assert eng.evaluate(_snap(3, a={"z": 1.0})) == []     # clear 1/2
+    assert eng.evaluate(_snap(4, a={"z": 9.0})) == []     # already firing
+    assert eng.evaluate(_snap(5, a={"z": 0.0})) == []     # clear 1/2
+    trs = eng.evaluate(_snap(6, a={"z": 0.0}))            # resolves
+    assert [t["state"] for t in trs] == ["resolved"]
+    assert eng.active() == []
+    # a missing signal neither breaches nor clears
+    assert eng.evaluate(_snap(7)) == []
+    assert eng.transitions == 2 and eng.evaluations == 7
+
+
+def test_rate_burn_rate_zscore_and_metric_signals():
+    reg = igg.metrics_registry()
+    eng = AlertEngine([
+        AlertRule("trips", "jobs.*.guard_trips", kind="rate",
+                  threshold=1.0, window=4),
+        AlertRule("slack", "jobs.*.deadline_slack_s", kind="burn_rate",
+                  horizon_s=60.0),
+        AlertRule("ckpt", "jobs.*.checkpoint_s", kind="zscore",
+                  threshold=4.0, min_samples=3),
+        AlertRule("metric", "metric:igg_live_test_total",
+                  kind="threshold", op=">=", threshold=2.0),
+    ], registry=reg)
+    c = reg.counter("igg_live_test_total", "t", ("k",))
+
+    def ev(t, trips, slack, ck):
+        return eng.evaluate(_snap(
+            t, a={"guard_trips": trips, "deadline_slack_s": slack,
+                  "checkpoint_s": ck}))
+
+    # warmup: counters flat, slack huge and steady, ckpt stable
+    for t in range(1, 5):
+        assert ev(t, 0, 1e4, 0.2) == []
+    # rate: the counter grew by 1 within the window -> trips fires
+    trs = ev(5, 1, 1e4, 0.2)
+    assert [t["rule"] for t in trs] == ["trips"]
+    # burn_rate: slack collapsing 1e4 -> 50 in 1s projects exhaustion
+    # far inside the horizon -> slack fires (value still > 0)
+    trs = ev(6, 1, 50.0, 0.2)
+    assert [t["rule"] for t in trs] == ["slack"]
+    assert trs[0]["severity"] == "warning" and trs[0]["job"] == "a"
+    # zscore: a 10x checkpoint against the stable window
+    trs = ev(7, 1, 30.0, 2.5)
+    assert [t["rule"] for t in trs] == ["ckpt"]
+    # metric: family SUM across label sets
+    c.inc(1, k="x")
+    c.inc(1, k="y")
+    trs = eng.evaluate(_snap(8))
+    assert [t["rule"] for t in trs] == ["metric"]
+    assert trs[0]["job"] is None  # scalar signal: no attribution
+    # every transition counted in igg_alerts_total{rule,severity,state}
+    fam = reg.get("igg_alerts_total")
+    counted = {lbl["rule"]: v for lbl, v in fam.samples()}
+    assert counted == {"trips": 1, "slack": 1, "ckpt": 1, "metric": 1}
+
+
+def test_burn_rate_fires_immediately_on_negative_slack():
+    eng = AlertEngine([AlertRule("slack", "jobs.*.deadline_slack_s",
+                                 kind="burn_rate")])
+    trs = eng.evaluate(_snap(1, a={"deadline_slack_s": -0.5}))
+    assert [(t["rule"], t["state"]) for t in trs] == [("slack", "firing")]
+
+
+def test_wildcard_fanout_is_per_job_state():
+    """One rule, independent state machines per wildcard match: job b
+    firing does not disturb job a's ok state."""
+    eng = AlertEngine([AlertRule("hot", "jobs.*.z", threshold=3.0)])
+    trs = eng.evaluate(_snap(1, a={"z": 0.1}, b={"z": 9.0}))
+    assert [(t["job"], t["state"]) for t in trs] == [("b", "firing")]
+    trs = eng.evaluate(_snap(2, a={"z": 9.0}, b={"z": 9.0}))
+    assert [(t["job"], t["state"]) for t in trs] == [("a", "firing")]
+    assert {a["job"] for a in eng.active()} == {"a", "b"}
+
+
+def test_engine_journals_transitions_and_contains_sink_errors():
+    """Transitions reach the journal callable as ``alert`` events; a
+    raising sink is counted, journaled once, and never propagates."""
+    journaled = []
+
+    def journal(kind, **fields):
+        journaled.append({"kind": kind, **fields})
+
+    def bad_sink(tr):
+        raise RuntimeError("boom")
+
+    good = []
+    eng = AlertEngine([AlertRule("hot", "jobs.*.z", threshold=3.0)],
+                      sinks=(bad_sink, good.append), journal=journal)
+    eng.evaluate(_snap(1, a={"z": 9.0}))
+    eng.evaluate(_snap(2, b={"z": 9.0}))
+    alerts = [e for e in journaled if e["kind"] == "alert"]
+    assert [(e["rule"], e["job"], e["state"]) for e in alerts] == [
+        ("hot", "a", "firing"), ("hot", "b", "firing")]
+    assert "t" not in alerts[0]  # the journal stamps its own clock
+    # the broken sink: both errors counted, journaled ONCE, good sink fed
+    errs = [e for e in journaled if e["kind"] == "alert_sink_error"]
+    assert len(errs) == 1 and "boom" in errs[0]["error"]
+    assert eng.sink_errors == 2
+    assert [tr["job"] for tr in good] == ["a", "b"]
+
+
+def test_control_file_sink_files_cancel_once(tmp_path):
+    from implicitglobalgrid_tpu.service import DirectoryBackend
+
+    backend = DirectoryBackend(str(tmp_path))
+    sink = ControlFileSink(backend, rules=("deadline_slack_burn",))
+    fire = {"rule": "deadline_slack_burn", "state": "firing", "job": "a"}
+    sink(fire)
+    sink(fire)                                        # dedup
+    sink(dict(fire, rule="other_rule"))               # filtered
+    sink(dict(fire, state="resolved"))                # not firing
+    sink(dict(fire, job=None))                        # unattributed
+    assert sink.filed == [{"rule": "deadline_slack_burn", "job": "a",
+                           "action": "cancel"}]
+    assert backend.poll_control() == [{"request": "cancel", "job": "a"}]
+    with pytest.raises(InvalidArgumentError, match="resize"):
+        ControlFileSink(backend, action="resize")     # payload required
+    with pytest.raises(InvalidArgumentError, match="action"):
+        ControlFileSink(backend, action="nuke")
+
+
+def test_webhook_sink_posts_and_swallows_errors():
+    """Delivery against a REAL local endpoint (a MetricsServer route);
+    an unreachable URL is swallowed and counted."""
+    seen = []
+
+    def routes(method, path, query, body):
+        if method == "POST" and path == "/hook":
+            seen.append(json.loads(body))
+            return 200, b"{}", "application/json"
+        return None
+
+    with MetricsServer(0, routes=routes) as srv:
+        sink = WebhookSink(f"http://127.0.0.1:{srv.port}/hook")
+        sink({"rule": "hot", "state": "firing", "job": "a"})
+        assert sink.delivered == 1 and sink.errors == 0
+        assert seen == [{"rule": "hot", "state": "firing", "job": "a"}]
+        bad = WebhookSink(f"http://127.0.0.1:{srv.port}/nope",
+                          timeout_s=2.0)
+        bad({"rule": "hot", "state": "firing"})
+        assert (bad.delivered, bad.errors) == (0, 1)
+        assert "404" in bad.last_error
+
+
+# ---------------------------------------------------------------------------
+# MetricsServer routes=: error paths + chunked streaming (the satellite)
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+def test_routes_error_paths_500_404_and_server_survives():
+    """A raising handler answers a JSON 500 and the server thread
+    survives to answer the next request; an unowned path answers a JSON
+    404; /metrics is untouched; the refcounted process-server bookkeeping
+    is unaffected by a standalone routed server."""
+    def routes(method, path, query, body):
+        if path == "/boom":
+            raise RuntimeError("handler bug")
+        if path == "/ok":
+            return 200, b'{"ok": true}', "application/json"
+        return None
+
+    assert igg.metrics_server() is None
+    with MetricsServer(0, routes=routes) as srv:
+        u = f"http://127.0.0.1:{srv.port}"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(u + "/boom")
+        assert exc.value.code == 500
+        rec = json.loads(exc.value.read())
+        assert "RuntimeError" in rec["error"]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(u + "/unknown")
+        assert exc.value.code == 404
+        assert "no route" in json.loads(exc.value.read())["error"]
+        # the thread survived both: normal requests still answer
+        status, body, _ = _get(u + "/ok")
+        assert (status, json.loads(body)) == (200, {"ok": True})
+        status, body, _ = _get(u + "/metrics")
+        assert status == 200
+        # a standalone routed server never touches the refcounted
+        # process singleton
+        assert igg.metrics_server() is None
+    igg.stop_metrics_server()  # no-op: nothing was registered
+
+
+def test_routes_iterator_payload_streams_chunked():
+    """A route returning a bytes iterator streams as HTTP/1.1 chunked
+    transfer — the client sees every yielded block, in order."""
+    def routes(method, path, query, body):
+        if path == "/stream":
+            return 200, (f"line {i}\n".encode() for i in range(5)), \
+                "application/x-ndjson"
+        return None
+
+    with MetricsServer(0, routes=routes) as srv:
+        u = f"http://127.0.0.1:{srv.port}/stream"
+        with urllib.request.urlopen(u, timeout=10) as r:
+            assert r.status == 200
+            assert r.headers.get("Transfer-Encoding") == "chunked"
+            assert r.headers.get("Content-Length") is None
+            lines = [ln.decode().strip() for ln in r]
+    assert lines == [f"line {i}" for i in range(5)]
+
+
+# ---------------------------------------------------------------------------
+# CLI: tools watch / tools alerts
+# ---------------------------------------------------------------------------
+
+def test_cli_watch_once_and_alerts_ack(tmp_path, capsys):
+    from implicitglobalgrid_tpu.tools import _cli
+
+    d = str(tmp_path)
+    s = _Stream(os.path.join(d, "job_a.jsonl"), "a")
+    s.append("run_begin", nt=8)
+    s.chunk(0)
+    s.append("deadline_slack", step=4, slack_s=-1.5, budget_s=2.0)
+    j = _Stream(os.path.join(d, "scheduler.jsonl"), "scheduler")
+    j.append("scheduler_start", policy="fifo")
+    j.append("alert", rule="deadline_slack_burn", severity="critical",
+             state="firing", job="a", value=-1.5, threshold=0.0)
+
+    assert _cli(["watch", d, "--once"]) == 0
+    frame = capsys.readouterr().out
+    assert "JOB" in frame and "a " in frame
+    assert "-1.5s" in frame
+    assert "ALERT CRITICAL deadline_slack_burn" in frame
+    assert "\x1b[2J" not in frame  # --once never clears the screen
+
+    assert _cli(["watch", d, "--once", "--json"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["jobs"]["a"]["deadline_slack_s"] == -1.5
+
+    assert _cli(["alerts", d]) == 0
+    out = capsys.readouterr().out
+    assert "deadline_slack_burn" in out and "firing" in out
+    assert _cli(["alerts", d, "--ack", "deadline_slack_burn:a"]) == 0
+    capsys.readouterr()
+    assert _cli(["alerts", d, "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["alerts"][0]["acked"] is True
+    # the ack landed in the SIDE file, not any journal
+    assert os.path.exists(os.path.join(d, "alerts_ack.json"))
+    tail = FlightTail(d)
+    tail.poll()
+    assert tail.gaps == []  # journals untouched, seq still gapless

@@ -17,6 +17,7 @@ the 20-job partition runs backend-only (no mesh); matrices ride `slow`.
 
 import io
 import json
+import os
 import urllib.error
 import urllib.request
 
@@ -107,7 +108,8 @@ def _post(url, payload=None, timeout=10):
 
 def test_public_api_exports():
     for sym in ("serve", "JobApiServer", "SnapshotQueryServer",
-                "BlockCache", "CachedSnapshot"):
+                "BlockCache", "CachedSnapshot",
+                "ObservePlane", "ObserveServer"):
         assert hasattr(igg, sym), sym
         assert sym in igg.__all__, sym
     from implicitglobalgrid_tpu import service
@@ -587,3 +589,208 @@ def test_query_server_validation(tmp_path):
         # write side is refused outright
         code, rec = _post(u + "/v1/snapshots")
         assert code == 405
+
+
+# ---------------------------------------------------------------------------
+# Live observability plane over HTTP (ISSUE 18): /v1/observe + /v1/events
+# ---------------------------------------------------------------------------
+
+def _obs_rec(kind, t, seq, **kw):
+    return {"t": t, "kind": kind, "run": "j1", "pid": 1, "proc": 0,
+            "seq": seq, **kw}
+
+
+def _obs_append(path, recs):
+    with open(path, "a") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+@pytest.mark.serve
+@pytest.mark.telemetry
+def test_observe_endpoints_snapshot_and_resumable_stream(tmp_path):
+    """The job API mounts the live plane over its own flight directory
+    (``observe=True``, the default): ``/v1/observe`` serves the
+    derived-signal snapshot, ``/v1/events`` streams the merged feed as
+    chunked NDJSON — heartbeat-terminated, ``since=`` resumable, with a
+    ``max_events`` cut whose final cursor resumes at the UNSENT tail —
+    and a bad query is a 400, not a dead stream. The standalone
+    `ObserveServer` serves the same plane without the job API;
+    ``observe=False`` unmounts it."""
+    from implicitglobalgrid_tpu.serve import ObserveServer
+
+    d = str(tmp_path / "svc")
+    os.makedirs(d)
+    p = os.path.join(d, "flight_j1.jsonl")
+    _obs_append(p, [
+        _obs_rec("recorder_open", 100.0, 0, wall=5000.0),
+        _obs_rec("chunk", 100.5, 1, chunk=0, step_begin=0, step_end=4,
+                 n=4, ok=True, reasons=[], build_s=0.01, exec_s=0.4),
+        _obs_rec("chunk", 101.0, 2, chunk=1, step_begin=4, step_end=8,
+                 n=4, ok=True, reasons=[], build_s=0.01, exec_s=0.4),
+        _obs_rec("deadline_slack", 101.1, 3, step=8, slack_s=-1.5),
+    ])
+
+    with JobApiServer(d) as api:
+        u = f"http://{api.host}:{api.port}"
+        # -- /v1/observe: the derived snapshot + the resume cursor ----------
+        _, body, _ = _get(u + "/v1/observe")
+        snap = json.loads(body)
+        assert snap["cursor"] == 3
+        j1 = snap["jobs"]["j1"]
+        assert j1["deadline_slack_s"] == -1.5
+        assert j1["step_s_p50"] == pytest.approx(0.1)
+        # -- /v1/events: NDJSON, chunked, ends with a done-heartbeat --------
+        status, body, hdrs = _get(
+            u + "/v1/events?since=-1&timeout_s=0.2&heartbeat_s=0.05")
+        assert status == 200
+        assert hdrs["Content-Type"] == "application/x-ndjson"
+        assert hdrs.get("Transfer-Encoding") == "chunked"
+        lines = [json.loads(x) for x in body.splitlines()]
+        evs = [e for e in lines if e["kind"] != "heartbeat"]
+        assert [e["live_seq"] for e in evs] == [0, 1, 2, 3]
+        assert [e["kind"] for e in evs] == [
+            "recorder_open", "chunk", "chunk", "deadline_slack"]
+        assert lines[-1]["kind"] == "heartbeat"
+        assert (lines[-1]["cursor"], lines[-1]["done"]) == (3, True)
+        # -- max_events cut: the final cursor resumes at the UNSENT tail ----
+        _, body, _ = _get(
+            u + "/v1/events?since=-1&max_events=2&timeout_s=5")
+        lines = [json.loads(x) for x in body.splitlines()]
+        assert [e.get("live_seq") for e in lines[:2]] == [0, 1]
+        assert (lines[-1]["cursor"], lines[-1]["done"]) == (1, True)
+        _, body, _ = _get(
+            u + f"/v1/events?since={lines[-1]['cursor']}&timeout_s=0.2")
+        lines = [json.loads(x) for x in body.splitlines()]
+        assert [e["live_seq"] for e in lines
+                if e["kind"] != "heartbeat"] == [2, 3]
+        # -- a mid-stream append arrives on the next resumed request --------
+        _obs_append(p, [
+            _obs_rec("chunk", 101.5, 4, chunk=2, step_begin=8,
+                     step_end=12, n=4, ok=True, reasons=[],
+                     build_s=0.01, exec_s=0.4)])
+        _, body, _ = _get(u + "/v1/events?since=3&timeout_s=0.2")
+        lines = [json.loads(x) for x in body.splitlines()]
+        assert [e["live_seq"] for e in lines
+                if e["kind"] != "heartbeat"] == [4]
+        # -- bad query: 400 JSON, not a dead stream -------------------------
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(u + "/v1/events?since=abc")
+        assert ei.value.code == 400
+        assert "bad /v1/events" in json.loads(ei.value.read())["error"]
+
+    # the standalone server: same plane, no job API, /metrics rides along
+    with ObserveServer(d) as obs:
+        uo = f"http://{obs.host}:{obs.port}"
+        _, body, _ = _get(uo + "/v1/observe")
+        snap = json.loads(body)
+        assert snap["jobs"]["j1"]["deadline_slack_s"] == -1.5
+        assert snap["cursor"] == 4
+        status, body, _ = _get(uo + "/metrics")
+        assert status == 200 and b"igg_" in body
+
+    # observe=False unmounts the plane (the job API alone)
+    with JobApiServer(d, observe=False) as api2:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"http://{api2.host}:{api2.port}/v1/observe")
+        assert ei.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# THE ISSUE-18 acceptance test: alerts fire under a live scheduler, a
+# sink cancels the bust job at a slice boundary, survivors bit-identical
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serve
+@pytest.mark.service
+@pytest.mark.faults
+def test_alerts_fire_sink_cancels_bust_job_survivors_bit_identical(
+        tmp_path):
+    """A live scheduler with the default rule pack + a `ControlFileSink`
+    serves three tenants: a clean job, a NaNPoke'd job (one guard trip,
+    recovered), and an admitted-but-over-budget job whose run-level
+    deadline slack goes negative at its FIRST chunk boundary.
+    ``guard_trip_storm`` and ``deadline_slack_burn`` FIRE — journaled
+    with the right job attribution, counted in ``igg_alerts_total`` —
+    while ``persistent_straggler`` stays silent (no in-process barrier
+    view); the sink files the cancel control file the scheduler consumes
+    at its next slice boundary, so the bust job dies CANCELLED mid-run;
+    and the surviving tenants end bit-identical to the CLI twin. The
+    journaled transitions then surface over HTTP: ``/v1/observe`` lists
+    both alerts active, ``/v1/events`` streams the transitions."""
+    from implicitglobalgrid_tpu.service import JobSpec
+    from implicitglobalgrid_tpu.service.job import builtin_setup
+    from implicitglobalgrid_tpu.serve import ObserveServer
+    from implicitglobalgrid_tpu.telemetry.live import ControlFileSink
+
+    ref = _twin_interior(tmp_path)
+    _reset_health_counters()
+    igg.reset_metrics()
+    d = str(tmp_path / "svc")
+    backend = DirectoryBackend(d)
+    sink = ControlFileSink(backend, rules=("deadline_slack_burn",))
+    with MeshScheduler(policy="round_robin", flight_dir=d, queue=backend,
+                       alerts=True, alert_sinks=(sink,)) as sched:
+        sched.submit(jobspec_from_json(_record("good")))
+        # the fault rides JobSpec (live objects, not queue JSON)
+        sched.submit(JobSpec(
+            name="poked", setup=builtin_setup("diffusion3d", "float64"),
+            nt=8, grid=GRID_A, model="diffusion3d",
+            run=igg.RunSpec(
+                nt_chunk=4, key=("serve", "poked"),
+                checkpoint_dir=str(tmp_path / "ck"),
+                faults=(igg.NaNPoke(step=6, name="T"),))))
+        # admitted (generous SPEC deadline prices fine) but over budget
+        # at RUN level: slack is negative from the first boundary on
+        sched.submit(jobspec_from_json(
+            _record("bust", deadline_s=3600.0,
+                    run={"nt_chunk": 4, "deadline_s": 1e-6})))
+        sched.run()
+
+        assert sched.job("good").state == JobState.DONE
+        assert sched.job("poked").state == JobState.DONE
+        # the alert-driven control file killed bust at a slice boundary
+        assert sched.job("bust").state == JobState.CANCELLED
+        assert sched.job("bust").run.step < 8  # mid-run, not completed
+        assert sink.filed == [{"rule": "deadline_slack_burn",
+                               "job": "bust", "action": "cancel"}]
+        # the fault tripped poked's guard exactly once (and recovered)
+        c = _health_counters()
+        assert c["guard_trips"] == 1 and c["rollbacks"] == 1
+        # survivors bit-identical to the solo CLI twin
+        assert np.array_equal(_interior(sched, "good"), ref)
+        assert np.array_equal(_interior(sched, "poked"), ref)
+
+    # -- the journal attributes every transition to the right job -----------
+    rep = igg.service_report(d)
+    alerts = rep["alerts"]
+    fired = {(a["rule"], a["job"]) for a in alerts["active"]}
+    assert ("deadline_slack_burn", "bust") in fired
+    assert ("guard_trip_storm", "poked") in fired
+    assert set(alerts["by_rule"]) == {"deadline_slack_burn",
+                                      "guard_trip_storm"}
+    assert alerts["by_rule"]["deadline_slack_burn"]["severity"] \
+        == "critical"
+    # ... and every transition is counted, per rule
+    fam = igg.metrics_registry().get("igg_alerts_total")
+    counted = {lbl["rule"] for lbl, v in fam.samples() if v > 0}
+    assert counted == {"deadline_slack_burn", "guard_trip_storm"}
+
+    # -- the HTTP surface shows the same picture ---------------------------
+    with ObserveServer(d, backend=DirectoryBackend(d)) as obs:
+        u = f"http://{obs.host}:{obs.port}"
+        _, body, _ = _get(u + "/v1/observe")
+        snap = json.loads(body)
+        active = {(a["rule"], a.get("job"))
+                  for a in snap["alerts"]["active"]}
+        assert {("deadline_slack_burn", "bust"),
+                ("guard_trip_storm", "poked")} <= active
+        assert not any(a["rule"] == "persistent_straggler"
+                       for a in snap["alerts"]["recent"])
+        assert snap["jobs"]["bust"]["deadline_slack_s"] < 0
+        _, body, _ = _get(u + "/v1/events?since=-1&timeout_s=0.3")
+        trans = [e for e in (json.loads(x) for x in body.splitlines())
+                 if e["kind"] == "alert"]
+        assert {(e["rule"], e.get("job")) for e in trans} == {
+            ("deadline_slack_burn", "bust"),
+            ("guard_trip_storm", "poked")}
