@@ -315,6 +315,23 @@ def main() -> None:
                          if r["metric"] == "query_cache_speedup")
     serve_ok = query_speedup is None or query_speedup >= 1.0
 
+    # --- closed-loop autoscaler (ISSUE 19) ---------------------------------
+    # per-boundary policy cost (< 2% of the slice it rides) and the
+    # reactivity gate: the drill's starved tenant must be GROWN and the
+    # idle one SHRUNK through the journaled control path with no
+    # operator input (`autoscale_reacts_ok`, rc 1 under
+    # IGG_BENCH_STRICT=1). Config owned by
+    # `bench_autoscale.run_autoscale_rows` (shared with the standalone).
+    import bench_autoscale
+
+    autoscale_rows = bench_autoscale.run_autoscale_rows(dims3, cpu)
+    for row in autoscale_rows:
+        results.append(bench_util.emit(row))
+    autoscale_ok = all(
+        (r["frac_of_slice"] < 0.02 if r["metric"] == "autoscale_decision_s"
+         else r["value"] >= 1.0)
+        for r in autoscale_rows)
+
     # --- static analysis: compile-time audit overhead ----------------------
     # run_resilient(audit=True)'s one-time trace+lower+parse+check cost as
     # a fraction of run time; target < 2% (ISSUE 7). Config owned by
@@ -386,7 +403,8 @@ def main() -> None:
     lint_failed = not ruff_missing and lint.returncode != 0
     if (not gate["ok"] or lint_failed or not coalesce8_ok
             or not ensemble_ok or not tuned_ok or not reshard_ok
-            or not staged_ok or not serve_ok or not live_ok) \
+            or not staged_ok or not serve_ok or not live_ok
+            or not autoscale_ok) \
             and os.environ.get("IGG_BENCH_STRICT") == "1":
         sys.exit(1)
 

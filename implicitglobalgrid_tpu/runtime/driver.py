@@ -634,6 +634,59 @@ class ResilientRun:
         self.tuned_stale = False
         self.tuned_stale_reason = None
 
+    def apply_tuned(self, cfg) -> None:
+        """Apply a (re)tuned `TunedConfig` to the LIVE run — the
+        scheduler's boundary re-tune after an autoscale resize
+        (`service.autoscale`). Subsequent chunk compiles resolve the
+        config's trace-time knob environment; after a resize the new
+        epoch's runner caches are empty, so the very next compile picks
+        it up. Structural knobs (overlap, a deep cadence baked into the
+        step body, ensemble stacking) are NOT re-applied — the step
+        function is already built, which is why a boundary re-tune
+        searches trace-time knobs only. Clears any stale flag and
+        records a ``tuned`` flight event."""
+        from ..telemetry.tune import TunedConfig
+        from ..utils.exceptions import InvalidArgumentError
+
+        if not isinstance(cfg, TunedConfig):
+            raise InvalidArgumentError(
+                f"apply_tuned takes a telemetry.TunedConfig; got "
+                f"{type(cfg).__name__}.")
+        self.tuned = cfg
+        self._tuned_env = cfg.env()
+        self.tuned_stale = False
+        self.tuned_stale_reason = None
+        self._record_event("tuned", model=cfg.model, **cfg.knobs(),
+                           predicted_step_s=cfg.predicted_step_s,
+                           measured_step_s=cfg.measured_step_s,
+                           speedup=cfg.speedup)
+
+    def reprice(self, step_s: float, *, bound=None, source=None) -> None:
+        """Replace the attached perf-model unit price (seconds per nt
+        unit). The autoscaler calls this after an applied resize so the
+        deadline-slack computation (`_check_deadline`) and the PerfWatch
+        measured/modeled ratio track the NEW geometry instead of the
+        admission-time price — without it, a grown job would keep
+        reading negative slack off the old price and the policy loop
+        would never converge. Records a ``perf_model`` flight event."""
+        from ..utils.exceptions import InvalidArgumentError
+
+        try:
+            step_s = float(step_s)
+        except (TypeError, ValueError):
+            step_s = 0.0
+        if not step_s > 0:
+            raise InvalidArgumentError(
+                f"reprice: step_s must be positive modeled seconds per "
+                f"step; got {step_s!r}.")
+        self._model_step_s = step_s
+        self._model_bound = bound
+        self._model_source = source
+        if self.watch is not None:
+            self.watch.model_step_s = step_s
+        self._record_event("perf_model", step_s=step_s, bound=bound,
+                           source=source)
+
     # -- the chunk-boundary iteration ---------------------------------------
 
     def advance(self) -> bool:

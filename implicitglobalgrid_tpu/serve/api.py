@@ -33,9 +33,12 @@ come free):
   same flight directory unless ``observe=False``): the derived-signal
   snapshot and the resumable chunked-NDJSON event stream.
 
-SECURITY: inherits `MetricsServer`'s loopback-by-default bind; the
-surface is unauthenticated by design — front it with an authenticating
-proxy before exposing it (docs/serving.md).
+SECURITY: inherits `MetricsServer`'s loopback-by-default bind, and the
+whole ``/v1`` surface — mutating AND read routes — can require a bearer
+token: pass ``api_token=`` (defaults from the ``IGG_API_TOKEN``
+environment variable) and every request must carry ``Authorization:
+Bearer <token>`` (constant-time compare; 401 otherwise). ``/metrics``
+and ``/healthz`` stay open for scrapers and supervisors (docs/api.md).
 """
 
 from __future__ import annotations
@@ -46,7 +49,7 @@ import os
 from ..service.backend import DirectoryBackend, QueueBackend
 from ..service.job import jobspec_from_json
 from ..service.report import is_service_dir, service_report
-from ..telemetry.server import MetricsServer
+from ..telemetry.server import MetricsServer, resolve_api_token
 from ..utils.exceptions import InvalidArgumentError
 
 __all__ = ["JobApiServer"]
@@ -59,13 +62,17 @@ class JobApiServer:
     docstring). ``backend`` defaults to the `DirectoryBackend` over
     that directory — pass the shared backend instance when schedulers
     use a custom one. ``port=0`` binds an ephemeral port — read
-    ``.port``. Context manager; `close()` stops the server (the queue
-    and any live scheduler are untouched — the API is stateless)."""
+    ``.port``. ``api_token`` requires ``Authorization: Bearer <token>``
+    on every ``/v1`` route (module docstring; defaults from
+    ``IGG_API_TOKEN``; pass ``api_token=False`` to force an
+    unauthenticated server even with the variable set). Context
+    manager; `close()` stops the server (the queue and any live
+    scheduler are untouched — the API is stateless)."""
 
     def __init__(self, flight_dir, port: int = 0, *,
                  host: str = "127.0.0.1", backend: QueueBackend | None = None,
                  registry=None, observe: bool = True,
-                 observe_window: int = 16):
+                 observe_window: int = 16, api_token=None):
         self.flight_dir = os.fspath(flight_dir)
         os.makedirs(self.flight_dir, exist_ok=True)
         if backend is not None and not isinstance(backend, QueueBackend):
@@ -85,7 +92,8 @@ class JobApiServer:
                                         backend=self.backend,
                                         window=observe_window)
         self._server = MetricsServer(port, host=host, registry=registry,
-                                     routes=self._route)
+                                     routes=self._route,
+                                     auth_token=resolve_api_token(api_token))
         self.host = self._server.host
         self.port = self._server.port
 

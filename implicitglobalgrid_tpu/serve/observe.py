@@ -36,9 +36,11 @@ transitions are NOT journaled (the scheduler's journal has exactly one
 writer); they surface in ``/v1/observe`` tagged ``source:
 "observer"``.
 
-SECURITY: inherits `MetricsServer`'s loopback-by-default bind; the
-surface is unauthenticated by design — front it with an authenticating
-proxy before exposing it (docs/serving.md).
+SECURITY: inherits `MetricsServer`'s loopback-by-default bind, and the
+``/v1`` surface can require a bearer token: pass ``api_token=``
+(defaults from ``IGG_API_TOKEN``) and every request must carry
+``Authorization: Bearer <token>`` (constant-time compare; 401
+otherwise) — ``/metrics`` + ``/healthz`` stay open (docs/api.md).
 """
 
 from __future__ import annotations
@@ -52,7 +54,7 @@ from collections import deque
 
 from ..service.backend import QueueBackend
 from ..telemetry.live import AlertEngine, LiveAggregate
-from ..telemetry.server import MetricsServer
+from ..telemetry.server import MetricsServer, resolve_api_token
 from ..utils.exceptions import InvalidArgumentError
 
 __all__ = ["ObservePlane", "ObserveServer"]
@@ -194,19 +196,25 @@ class ObserveServer:
     `ObservePlane` on its own `MetricsServer` (``/metrics`` +
     ``/healthz`` come free), for deployments that want the live plane
     without the job API. ``port=0`` binds an ephemeral port — read
-    ``.port``. Context manager; `close()` stops the server only (the
-    flight files and any live scheduler are untouched)."""
+    ``.port``. ``api_token`` requires ``Authorization: Bearer <token>``
+    on the ``/v1`` routes (module docstring; defaults from
+    ``IGG_API_TOKEN``; ``False`` = explicitly unauthenticated). Context
+    manager; `close()` stops the server only (the flight files and any
+    live scheduler are untouched)."""
 
     def __init__(self, flight_dir, port: int = 0, *,
                  host: str = "127.0.0.1",
                  backend: QueueBackend | None = None, rules=None,
-                 sinks=(), window: int = 16, registry=None):
+                 sinks=(), window: int = 16, registry=None,
+                 api_token=None):
         self.flight_dir = os.fspath(flight_dir)
         self.plane = ObservePlane(self.flight_dir, backend=backend,
                                   rules=rules, sinks=sinks,
                                   window=window)
-        self._server = MetricsServer(port, host=host, registry=registry,
-                                     routes=self.plane.routes)
+        self._server = MetricsServer(
+            port, host=host, registry=registry,
+            routes=self.plane.routes,
+            auth_token=resolve_api_token(api_token))
         self.host = self._server.host
         self.port = self._server.port
 

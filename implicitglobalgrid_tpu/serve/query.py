@@ -28,7 +28,10 @@ corrupt container 503 (retry after the writer commits).
 
 Status codes aside, the server never touches the mesh — deploy it on
 any host that can read the snapshot root (see docs/serving.md for
-deployment + cache sizing).
+deployment + cache sizing). The ``/v1`` routes can require a bearer
+token: pass ``api_token=`` (defaults from ``IGG_API_TOKEN``) and every
+request must carry ``Authorization: Bearer <token>`` (constant-time
+compare; 401 otherwise) — ``/metrics`` + ``/healthz`` stay open.
 """
 
 from __future__ import annotations
@@ -40,7 +43,7 @@ import os
 import numpy as np
 
 from ..io.reader import list_snapshots
-from ..telemetry.server import MetricsServer
+from ..telemetry.server import MetricsServer, resolve_api_token
 from ..utils.exceptions import IncoherentArgumentError, InvalidArgumentError
 from .cache import BlockCache, CachedSnapshot
 
@@ -80,17 +83,22 @@ class SnapshotQueryServer:
     (see module docstring). ``port=0`` binds an ephemeral port — read
     ``.port``. ``cache_bytes`` bounds the shared block LRU (sizing: a
     few times the hot fields' per-block bytes; stats on
-    ``/v1/snapshots``). Context manager; `close()` stops the server."""
+    ``/v1/snapshots``). ``api_token`` requires ``Authorization: Bearer
+    <token>`` on the ``/v1`` routes (defaults from ``IGG_API_TOKEN``;
+    ``False`` = explicitly unauthenticated). Context manager; `close()`
+    stops the server."""
 
     def __init__(self, root, port: int = 0, *, host: str = "127.0.0.1",
-                 cache_bytes: int = 256 << 20, registry=None):
+                 cache_bytes: int = 256 << 20, registry=None,
+                 api_token=None):
         self.root = os.fspath(root)
         if not os.path.isdir(self.root):
             raise InvalidArgumentError(
                 f"Snapshot root not found: {self.root}")
         self.cache = BlockCache(cache_bytes)
-        self._server = MetricsServer(port, host=host, registry=registry,
-                                     routes=self._route)
+        self._server = MetricsServer(
+            port, host=host, registry=registry, routes=self._route,
+            auth_token=resolve_api_token(api_token))
         self.host = self._server.host
         self.port = self._server.port
 

@@ -20,8 +20,20 @@ record-to-`JobSpec` code path the CLI (`tools jobs submit`) and the HTTP
 front door (`serve.JobApiServer`) share. Jobs with a ``deadline_s`` are
 priced at admission (`telemetry.predict_step`) and REJECTED when their
 completion provably busts the budget.
+
+The CLOSED LOOP (ISSUE 19): `MeshScheduler(autoscale=AutoscalePolicy(...))`
+runs an `Autoscaler` at every slice boundary — it reads the live signals
+(deadline slack, queue pressure), generates candidate ``dims`` moves
+inside per-job `ScaleBounds`, PRICES each with `telemetry.predict_step`
++ `predict_reshard` (a move files only when its amortized break-even
+lands inside the job's remaining horizon), damps bounced signals with
+hysteresis + cooldown, actuates through the control-file path, re-tunes
+the resized job at the boundary, and journals every decision —
+rejections included — as ``autoscale_decision`` records that
+`service_report` and ``tools autoscale explain`` reconstruct.
 """
 
+from .autoscale import Autoscaler, AutoscalePolicy, ScaleBounds
 from .backend import DirectoryBackend, QueueBackend
 from .job import (
     BUILTIN_MODELS, Job, JobSpec, JobState, builtin_setup,
@@ -31,7 +43,10 @@ from .policies import (
     FairSharePolicy, FifoPolicy, POLICIES, RoundRobinPolicy,
     SchedulingPolicy, resolve_policy,
 )
-from .report import export_service_trace, is_service_dir, service_report
+from .report import (
+    explain_autoscale, export_service_trace, is_service_dir,
+    service_report,
+)
 from .scheduler import MeshScheduler
 
 __all__ = [
@@ -42,4 +57,5 @@ __all__ = [
     "SchedulingPolicy", "FifoPolicy", "RoundRobinPolicy",
     "FairSharePolicy", "POLICIES", "resolve_policy",
     "service_report", "export_service_trace", "is_service_dir",
+    "Autoscaler", "AutoscalePolicy", "ScaleBounds", "explain_autoscale",
 ]

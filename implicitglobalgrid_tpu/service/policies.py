@@ -73,12 +73,50 @@ class FairSharePolicy(SchedulingPolicy):
     ``granted_s / priority`` (ties: submission order). New jobs start at
     the current minimum share rather than zero, so a late submission
     catches up without starving everyone else of the mesh for its whole
-    backlog."""
+    backlog.
+
+    Deadline softening (ISSUE 19): before the alert engine's HARD
+    ``deadline_missed``/cancel path ever fires, a job whose live
+    ``deadline_slack_s`` has dropped below ``low_slack_s`` gets its
+    effective share divided by up to ``1 + slack_boost`` — a stride
+    boost that GROWS as slack sinks through ``slack_horizon_s``, so the
+    scheduler spends mesh time where the deadline pressure is, smoothly
+    and reversibly. The boost reads the driver's live gauge only at
+    pick time; ``granted`` accounting is untouched, so a job whose
+    slack recovers pays its fair share back. Jobs without a deadline
+    (slack None) never boost — the policy is byte-identical to plain
+    fair share for them."""
 
     name = "fair"
 
-    def __init__(self):
+    def __init__(self, *, low_slack_s: float = 0.0,
+                 slack_boost: float = 4.0,
+                 slack_horizon_s: float = 30.0):
+        if slack_boost < 0:
+            raise InvalidArgumentError(
+                f"FairSharePolicy: slack_boost must be >= 0; got "
+                f"{slack_boost!r}.")
+        if not slack_horizon_s > 0:
+            raise InvalidArgumentError(
+                f"FairSharePolicy: slack_horizon_s must be > 0; got "
+                f"{slack_horizon_s!r}.")
+        self.low_slack_s = float(low_slack_s)
+        self.slack_boost = float(slack_boost)
+        self.slack_horizon_s = float(slack_horizon_s)
         self._share: dict = {}  # job index -> granted_s / weight
+
+    def _boost(self, job: Job) -> float:
+        """> 1 when the job's live deadline slack is below
+        ``low_slack_s``, saturating at ``1 + slack_boost`` once the
+        deficit spans ``slack_horizon_s``."""
+        if self.slack_boost == 0 or job.run is None:
+            return 1.0
+        slack = getattr(job.run, "deadline_slack_s", None)
+        if slack is None or slack >= self.low_slack_s:
+            return 1.0
+        deficit = min(1.0, (self.low_slack_s - float(slack))
+                      / self.slack_horizon_s)
+        return 1.0 + self.slack_boost * deficit
 
     def pick(self, candidates: list) -> Job:
         # the floor is the RUNNABLE minimum: a finished job's frozen
@@ -92,7 +130,8 @@ class FairSharePolicy(SchedulingPolicy):
             if j.index not in self._share:
                 self._share[j.index] = floor
         return min(candidates,
-                   key=lambda j: (self._share[j.index], j.index))
+                   key=lambda j: (self._share[j.index] / self._boost(j),
+                                  j.index))
 
     def granted(self, job: Job, slice_s: float) -> None:
         w = max(1, int(job.spec.priority))

@@ -30,7 +30,8 @@ import os
 from ..telemetry.recorder import read_flight_events
 from ..utils.exceptions import InvalidArgumentError
 
-__all__ = ["service_report", "export_service_trace", "read_journal"]
+__all__ = ["service_report", "export_service_trace", "read_journal",
+           "explain_autoscale"]
 
 _JOURNAL = "scheduler.jsonl"
 _TERMINAL_KINDS = {"job_done": "done", "job_failed": "failed",
@@ -66,6 +67,107 @@ def read_journal(source) -> list:
 def _job_file(flight_dir, name: str) -> str | None:
     p = os.path.join(os.fspath(flight_dir), f"job_{name}.jsonl")
     return p if os.path.isfile(p) else None
+
+
+def _autoscale_moves(events: list) -> list:
+    """Reconstruct every autoscaler-FILED move's actuation chain from
+    the journal alone: ``autoscale_decision`` (verdict filed) ->
+    ``control`` -> ``resize_requested`` -> ``job_resized`` ->
+    ``job_retuned`` — the proof each resize went through the same
+    control path an operator's would, with its pricing attached."""
+    moves: list = []
+    pending: dict = {}
+    for e in events:
+        k = e.get("kind")
+        name = e.get("job")
+        if k == "autoscale_decision" and e.get("verdict") == "filed":
+            rec = {"job": name, "action": e.get("action"),
+                   "dims": e.get("dims"), "new_dims": e.get("new_dims"),
+                   "t_decided": e.get("t"), "via": e.get("via"),
+                   "streak": e.get("streak"),
+                   "signals": e.get("signals"),
+                   "pricing": e.get("pricing"),
+                   "applied": False, "chain": ["autoscale_decision"]}
+            pending[name] = rec
+            moves.append(rec)
+            continue
+        rec = pending.get(name)
+        if rec is None:
+            continue
+        if k == "control" and e.get("request") == "resize" \
+                and not rec["applied"]:
+            rec["chain"].append("control")
+        elif k == "resize_requested" and not rec["applied"]:
+            rec["chain"].append("resize_requested")
+        elif k == "job_resized":
+            if list(e.get("new_dims") or ()) \
+                    == list(rec["new_dims"] or ()):
+                rec.update(applied=True, t_applied=e.get("t"),
+                           resize_s=e.get("dur_s"), step=e.get("step"))
+                rec["chain"].append("job_resized")
+        elif k == "resize_rejected" and not rec["applied"]:
+            rec["rejected"] = e.get("error")
+            rec["chain"].append("resize_rejected")
+            del pending[name]
+        elif k == "job_retuned" and rec["applied"]:
+            rec["retuned"] = {key: v for key, v in e.items()
+                              if key not in ("kind", "t", "run", "job")}
+            rec["chain"].append("job_retuned")
+            del pending[name]
+        elif k == "job_retune_failed" and rec["applied"]:
+            rec["retune_failed"] = e.get("error")
+            rec["chain"].append("job_retune_failed")
+            del pending[name]
+    return moves
+
+
+def _autoscale_section(events: list):
+    """The ``"autoscale"`` report section (None when the run had no
+    autoscaler and no decisions): policy echo, verdict counts,
+    rejection histogram, and the reconstructed move chains."""
+    start = next((e for e in events
+                  if e.get("kind") == "scheduler_start"), None)
+    decisions = [e for e in events
+                 if e.get("kind") == "autoscale_decision"]
+    policy = (start or {}).get("autoscale")
+    if not decisions and policy is None:
+        return None
+    reasons: dict = {}
+    filed = rejected = 0
+    for e in decisions:
+        if e.get("verdict") == "filed":
+            filed += 1
+        elif e.get("verdict") == "rejected":
+            rejected += 1
+            r = str(e.get("reason"))
+            reasons[r] = reasons.get(r, 0) + 1
+    return {"policy": policy, "decisions": len(decisions),
+            "filed": filed, "rejected": rejected,
+            "rejected_by_reason": reasons,
+            "moves": _autoscale_moves(events)}
+
+
+def explain_autoscale(source) -> dict:
+    """WHY did the mesh resize itself — reconstructed from the journal
+    ALONE (the ``tools autoscale explain`` engine). The
+    `_autoscale_section` record (policy echo, verdict counts, every
+    filed move's full actuation chain with its pricing breakdown) plus
+    ``jobs``: each job's complete decision history — every journaled
+    verdict with its signal snapshot, so a rejection ("hysteresis",
+    "cooldown", "priced_out", ...) is as explainable as a move."""
+    events = read_journal(source)
+    sec = _autoscale_section(events) or {
+        "policy": None, "decisions": 0, "filed": 0, "rejected": 0,
+        "rejected_by_reason": {}, "moves": []}
+    per_job: dict = {}
+    for e in events:
+        if e.get("kind") != "autoscale_decision":
+            continue
+        per_job.setdefault(str(e.get("job")), []).append(
+            {k: v for k, v in e.items()
+             if k not in ("kind", "run")})
+    sec["jobs"] = per_job
+    return sec
 
 
 def service_report(source, *, include_jobs: bool = True) -> dict:
@@ -138,6 +240,13 @@ def service_report(source, *, include_jobs: bool = True) -> dict:
                 rec(e["job"]).setdefault("alerts", []).append(
                     {"rule": e.get("rule"), "state": e.get("state"),
                      "severity": e.get("severity"), "t": e.get("t")})
+        elif k == "job_resized":
+            r = rec(e["job"])
+            r["resizes"] = r.get("resizes", 0) + 1
+            r["dims"] = e.get("new_dims")
+        elif k == "job_retuned":
+            r = rec(e["job"])
+            r["retunes"] = r.get("retunes", 0) + 1
         elif k == "slice":
             r = rec(e["job"])
             r["slices"] += 1
@@ -199,6 +308,9 @@ def service_report(source, *, include_jobs: bool = True) -> dict:
     from ..telemetry.report import _alerts_section
 
     report["alerts"] = _alerts_section(alerts)
+    autoscale = _autoscale_section(events)
+    if autoscale is not None:
+        report["autoscale"] = autoscale
     if submit_rejected:
         report["submit_rejected"] = submit_rejected
     if stop is not None:

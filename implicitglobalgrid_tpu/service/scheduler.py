@@ -53,6 +53,7 @@ from ..telemetry import hooks
 from ..telemetry.live import AlertEngine
 from ..telemetry.recorder import FlightRecorder, use_flight_recorder
 from ..utils.exceptions import InvalidArgumentError
+from .autoscale import Autoscaler, AutoscalePolicy
 from .backend import DirectoryBackend, QueueBackend
 from .job import Job, JobSpec, JobState, jobspec_from_json
 from .policies import resolve_policy
@@ -113,7 +114,7 @@ class MeshScheduler:
                  metrics_port: int | None = None,
                  healthz_max_age_s: float | None = None,
                  queue: QueueBackend | None = None,
-                 alerts=None, alert_sinks=()):
+                 alerts=None, alert_sinks=(), autoscale=None):
         self.policy = resolve_policy(policy)
         self.flight_dir = None if flight_dir is None else str(flight_dir)
         self.jobs: dict = {}
@@ -172,6 +173,26 @@ class MeshScheduler:
             raise InvalidArgumentError(
                 "alert_sinks without alerts: pass alerts=True (default "
                 "rule pack), a rule list, or an AlertEngine.")
+        # the closed-loop autoscaler (ISSUE 19): ``autoscale=True`` turns
+        # on the default policy, an AutoscalePolicy (or its kwargs dict)
+        # customizes it, a ready Autoscaler is adopted as-is. It
+        # evaluates over the SAME live snapshot as the alert engine after
+        # every granted slice and actuates through the control path —
+        # priced, hysteresis-damped, journaled (service.autoscale).
+        self.autoscaler = None
+        if isinstance(autoscale, Autoscaler):
+            self.autoscaler = autoscale
+        elif isinstance(autoscale, (AutoscalePolicy, dict)):
+            self.autoscaler = Autoscaler(autoscale)
+        elif autoscale is True or autoscale == "default":
+            self.autoscaler = Autoscaler()
+        elif autoscale:
+            raise InvalidArgumentError(
+                "autoscale must be True (default policy), an "
+                "AutoscalePolicy (or its kwargs dict), or an Autoscaler; "
+                f"got {type(autoscale).__name__}.")
+        if self.autoscaler is not None:
+            self.autoscaler.attach(self)
         try:
             if metrics_port is not None:
                 from ..telemetry.server import start_metrics_server
@@ -193,7 +214,9 @@ class MeshScheduler:
                   metrics_port=None if self._server is None
                   else self._server.port,
                   queue_owner=None if self.queue is None
-                  else getattr(self.queue, "owner", None))
+                  else getattr(self.queue, "owner", None),
+                  autoscale=None if self.autoscaler is None
+                  else self.autoscaler.policy.describe())
 
     @staticmethod
     def _audit_total() -> float:
@@ -390,6 +413,11 @@ class MeshScheduler:
             # signals only change when a slice ran, and a sink's control
             # file lands before the very next _poll_control
             self.alert_engine.evaluate(self._live_signals())
+        if self.autoscaler is not None:
+            # after the alert engine: a hard alert action (cancel) filed
+            # this boundary lands in _poll_control before any autoscale
+            # move of the SAME job can waste a slice on it
+            self.autoscaler.evaluate(self._live_signals())
         hooks.note_scheduler_heartbeat(granted=True)
         return True
 
@@ -441,6 +469,10 @@ class MeshScheduler:
                     and getattr(run, "deadline_missed", False)),
                 "perf_regressions": 0 if watch is None
                 else getattr(watch, "regressions", 0),
+                "priority": int(j.spec.priority),
+                "devices": None if j.gg is None
+                else int(j.gg.dims[0]) * int(j.gg.dims[1])
+                * int(j.gg.dims[2]),
             }
         queue = {
             "queued": sum(1 for j in self._order
@@ -610,6 +642,9 @@ class MeshScheduler:
                       **tuned.knobs(), speedup=tuned.speedup)
         self._log("job_admitted", job=job.name, admit_s=job.admit_s,
                   epoch=int(job.gg.epoch))
+        hooks.note_job_target_devices(
+            job.scope, int(job.gg.dims[0]) * int(job.gg.dims[1])
+            * int(job.gg.dims[2]))
 
     def _price_admission(self, job: Job, run_spec, tuned, state):
         """Deadline-aware admission (runs under the job's grid, state
@@ -695,12 +730,91 @@ class MeshScheduler:
             raise _DeadlineRejected(rec)
         return pred["step_s"] * steps_per_unit
 
+    def _retune(self, job: Job, reason) -> bool:
+        """Boundary re-tune (the autoscale loop's closing rung): re-RUN
+        `telemetry.tune_config` against the job's LIVE geometry —
+        model-only (``measure=False``; a measured calibration run would
+        stall every tenant) — and apply the winner to the running job
+        (`ResilientRun.apply_tuned`). Structural knobs are FROZEN at
+        their live values: ``comm_every`` is baked into the compiled
+        step body at setup, ``overlap`` schedules that body, and
+        ``ensemble`` shapes the state — only re-admission could change
+        them. ``wire_dtype`` is frozen too: a re-tune must never switch
+        a tenant onto a lossy wire mid-run (trajectories stay
+        bit-identical to the solo reference). What IS searched are the
+        bit-exact transport knobs — halo coalescing and the
+        topology-staged wire. Journals ``job_retuned`` (or
+        ``job_retune_failed``) and re-prices the driver so deadline
+        slack tracks the tuned geometry. Returns True when a config was
+        applied."""
+        from ..models.common import resolve_comm_every
+        from ..telemetry.tune import _MODEL_STAGGER, tune_config
+
+        model = job.spec.model
+        if model not in _MODEL_STAGGER or job.run is None \
+                or job.gg is None:
+            return False
+        t0 = time.monotonic()
+        gg = job.gg
+        run = job.run
+        tuned = run.tuned
+        cur = dict(comm_every=1, overlap=False, coalesce=True,
+                   wire_dtype=None, wire_stage=None)
+        if tuned is not None:
+            cur = dict(comm_every=tuned.comm_every,
+                       overlap=bool(tuned.overlap),
+                       coalesce=tuned.coalesce,
+                       wire_dtype=tuned.wire_dtype,
+                       wire_stage=tuned.wire_stage)
+        n = tuple(int(v) for v in gg.nxyz)
+        grid = dict(nx=n[0], ny=n[1], nz=n[2],
+                    dimx=int(gg.dims[0]), dimy=int(gg.dims[1]),
+                    dimz=int(gg.dims[2]),
+                    periodx=int(gg.periods[0]),
+                    periody=int(gg.periods[1]),
+                    periodz=int(gg.periods[2]),
+                    overlaps=tuple(int(o) for o in gg.overlaps),
+                    halowidths=tuple(int(h) for h in gg.halowidths))
+        dtype = str(next(iter(run.state.values())).dtype)
+        try:
+            cfg = tune_config(
+                model, grid, dtype=dtype,
+                comm_every_options=(cur["comm_every"],),
+                wire_dtype_options=(cur["wire_dtype"],),
+                wire_stage_options=tuple(dict.fromkeys(
+                    [cur["wire_stage"], None, "z:staged"])),
+                coalesce_options=tuple(dict.fromkeys(
+                    [cur["coalesce"], True, False])),
+                overlap_options=(cur["overlap"],),
+                ensemble_options=(run.ensemble,),
+                measure=False)
+            run.apply_tuned(cfg)
+        except Exception as e:
+            self._log("job_retune_failed", job=job.name, model=model,
+                      reason=reason, error=f"{type(e).__name__}: {e}")
+            return False
+        search_s = time.monotonic() - t0
+        self._log("job_retuned", job=job.name, model=model,
+                  reason=reason, **cfg.knobs(),
+                  predicted_step_s=cfg.predicted_step_s,
+                  search_s=search_s)
+        if cfg.predicted_step_s:
+            cadence = resolve_comm_every(cfg.comm_every)
+            spu = cadence.cycle if cadence.deep else 1
+            try:
+                run.reprice(float(cfg.predicted_step_s) * spu,
+                            source="autoscale_retune")
+            except InvalidArgumentError:
+                pass
+        return True
+
     def _slice(self, job: Job) -> None:
         """Grant ``job`` one chunk-boundary slice (admitting it first if
         this is its first grant). A raising slice FAILS the job alone."""
         t_pick = time.monotonic()
         wait_s = max(0.0, t_pick - (job.last_end_t or t_pick))
         chunks0 = 0 if job.run is None else len(job.run.reports)
+        resized = False
         try:
             if job.state == JobState.QUEUED:
                 self._admit(job)
@@ -726,7 +840,10 @@ class MeshScheduler:
                                       new_dims=list(new_dims), via=via,
                                       error=f"{type(e).__name__}: {e}")
                             more = not job.run.done
+                            if self.autoscaler is not None:
+                                self.autoscaler.on_resize_rejected(job)
                         else:
+                            resized = True
                             more = not job.run.done
                             self._log("job_resized", job=job.name,
                                       new_dims=list(new_dims),
@@ -735,6 +852,11 @@ class MeshScheduler:
                                       rounds=rec.get("rounds"),
                                       wire_bytes=rec.get("wire_bytes"),
                                       step=job.step)
+                            if self.autoscaler is not None:
+                                # the policy repriced this geometry when
+                                # it filed the move: hand the driver the
+                                # priced unit cost so slack converges
+                                self.autoscaler.on_resized(job, new_dims)
                     else:
                         more = job.run.advance()
                 # a resize or elastic restart inside the slice re-inits
@@ -746,6 +868,11 @@ class MeshScheduler:
                     top.retain_epoch(cur.epoch)
                     top.release_epoch(old.epoch)
                     _evict_epoch_caches(old.epoch)
+                    if job.scope is not None:
+                        hooks.note_job_target_devices(
+                            job.scope,
+                            int(cur.dims[0]) * int(cur.dims[1])
+                            * int(cur.dims[2]))
             finally:
                 top.swap_global_grid(prev)
         except _DeadlineRejected as e:
@@ -772,13 +899,25 @@ class MeshScheduler:
             self._log("deadline_missed", job=job.name, step=job.step,
                       deadline_s=job.run.deadline_s)
         # re-tune trigger (ROADMAP tuner rung c): a resize or PerfWatch
-        # drift marked the applied TunedConfig stale — the scheduler
-        # reacts at the slice boundary by clearing it (journaled; the
-        # operator re-runs `tools tune` against the new geometry)
+        # drift marked the applied TunedConfig stale. With the
+        # autoscaler's closed loop on (policy.retune), the scheduler
+        # re-RUNS the tuner against the live geometry right here at the
+        # boundary — model-only, trace-time knobs — and applies the
+        # winner; otherwise (or when the re-tune itself fails) it falls
+        # back to clearing the stale config (journaled; the operator
+        # re-runs `tools tune`). A resize of a never-tuned job re-tunes
+        # too: the new geometry deserves a knob search either way.
+        retune_on = self.autoscaler is not None \
+            and self.autoscaler.policy.retune and not job.finished
         if job.run is not None and getattr(job.run, "tuned_stale", False):
             reason = job.run.tuned_stale_reason
-            job.run.clear_tuned()
-            self._log("job_tuned_cleared", job=job.name, reason=reason)
+            if not (retune_on and self._retune(job, reason)):
+                job.run.clear_tuned()
+                self._log("job_tuned_cleared", job=job.name,
+                          reason=reason)
+        elif resized and retune_on and job.run is not None \
+                and not job.run.done:
+            self._retune(job, "resize")
         if not more:
             self._finalize(job, JobState.DONE)
 
@@ -858,6 +997,8 @@ class MeshScheduler:
                       new_dims=list(new_dims), via=via,
                       error=f"job reached terminal state {state} before "
                             "the resize slice")
+            if self.autoscaler is not None:
+                self.autoscaler.on_resize_rejected(job)
         if job.run is not None:
             if state == JobState.DONE:
                 from ..utils.timing import sync

@@ -84,8 +84,8 @@ from .live import (
 )
 from .perfdb import metric_direction, perfdb_add, perfdb_check, perfdb_load
 from .perfmodel import (
-    MachineProfile, PerfWatch, STEP_WORKLOADS, StepWorkload,
-    default_machine_profile, hierarchical_machine_profile,
+    MachineProfile, PerfWatch, ReshardPrediction, STEP_WORKLOADS,
+    StepWorkload, default_machine_profile, hierarchical_machine_profile,
     load_machine_profile, predict_reshard,
     predict_step, robust_z, save_machine_profile,
 )
@@ -127,7 +127,7 @@ __all__ = [
     "default_machine_profile", "hierarchical_machine_profile",
     "load_machine_profile",
     "save_machine_profile", "predict_step", "predict_reshard",
-    "calibrate_machine",
+    "ReshardPrediction", "calibrate_machine",
     "metric_direction", "perfdb_add", "perfdb_check", "perfdb_load",
     "TunedConfig", "tune_config", "save_tuned_config",
     "load_tuned_config", "resolve_tuned", "tuned_config_path",

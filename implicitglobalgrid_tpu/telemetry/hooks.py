@@ -24,7 +24,8 @@ __all__ = ["note_runner_cache", "account_halo_exchange",
            "observe_job_slice", "clear_scheduler_heartbeat",
            "note_job_transition", "observe_member_health",
            "observe_reshard", "note_deadline_slack", "note_queue_backlog",
-           "note_alert"]
+           "note_alert", "note_autoscale_decision",
+           "note_job_target_devices"]
 
 # Metric family names (the exported contract; see docs/observability.md).
 RUNNER_CACHE = "igg_runner_cache_total"
@@ -82,6 +83,12 @@ JOB_DEADLINE_SLACK = "igg_job_deadline_slack_seconds"
 QUEUE_PENDING = "igg_queue_pending"
 QUEUE_OLDEST = "igg_queue_oldest_age_seconds"
 ALERTS_TOTAL = "igg_alerts_total"
+# closed-loop autoscaler (ISSUE 19): policy verdicts + the per-job
+# target-geometry gauge (scoped per the label-shape rule above)
+AUTOSCALE_DECISIONS = "igg_autoscale_decisions_total"
+AUTOSCALE_RESIZES = "igg_autoscale_resizes_total"
+AUTOSCALE_REJECTED = "igg_autoscale_rejected_total"
+JOB_TARGET_DEVICES = "igg_job_target_devices"
 
 
 def runner_cache_misses() -> float:
@@ -381,6 +388,44 @@ def note_alert(rule: str, severity: str, state: str) -> None:
         "Alert-engine state transitions by rule, severity, and new state.",
         ("rule", "severity", "state")).inc(
         1, rule=str(rule), severity=str(severity), state=str(state))
+
+
+def note_autoscale_decision(action: str, verdict: str,
+                            reason: str | None = None) -> None:
+    """Count one autoscaler policy verdict
+    (``igg_autoscale_decisions_total{action,verdict}``; ``action``:
+    ``grow`` | ``shrink``, ``verdict``: ``filed`` | ``rejected``). A
+    filed move also bumps ``igg_autoscale_resizes_total``; a rejection
+    bumps ``igg_autoscale_rejected_total{reason}`` (``hysteresis`` /
+    ``cooldown`` / ``priced_out`` / ...). The journal's
+    ``autoscale_decision`` event is the detailed twin carrying the full
+    signal snapshot and pricing breakdown."""
+    reg = metrics_registry()
+    reg.counter(
+        AUTOSCALE_DECISIONS,
+        "Autoscaler policy verdicts by candidate action and outcome.",
+        ("action", "verdict")).inc(
+        1, action=str(action), verdict=str(verdict))
+    if verdict == "filed":
+        reg.counter(
+            AUTOSCALE_RESIZES,
+            "Resizes the autoscaler filed through the control path."
+            ).inc(1)
+    elif verdict == "rejected":
+        reg.counter(
+            AUTOSCALE_REJECTED,
+            "Autoscale candidates rejected before actuation, by reason.",
+            ("reason",)).inc(1, reason=str(reason or "unknown"))
+
+
+def note_job_target_devices(scope, devices: int) -> None:
+    """Stamp the device count the autoscaler currently targets for one
+    job (its `ScopedRegistry` view — the gauge an operator compares
+    against the mesh's pool size to see the policy's live allocation)."""
+    scope.gauge(
+        JOB_TARGET_DEVICES,
+        "Devices this job's decomposition currently targets (product of "
+        "its dims; moved by autoscale resizes).").set(int(devices))
 
 
 def job_gauges(registry, job: str):

@@ -201,6 +201,14 @@ class LiveAggregate:
         self._queue: dict = {}
         self._sched = {"slices": 0, "draining": False, "last_t": None,
                        "started": False, "stopped": False}
+        # the autoscaler's journaled policy verdicts (ISSUE 19) — the
+        # observer-side twin of the scheduler's closed loop: policy
+        # echo, verdict counts, and the recent decision records the
+        # /v1/observe "autoscale" section serves
+        self._autoscale = {"policy": None, "decisions": 0, "filed": 0,
+                           "rejected": 0, "resizes": 0, "retunes": 0,
+                           "last": None}
+        self._autoscale_recent: deque = deque(maxlen=32)
         self.align: dict = {}          # run id -> alignment metadata
 
     # -- tail + alignment --------------------------------------------------
@@ -408,6 +416,8 @@ class LiveAggregate:
         name = e.get("job")
         if kind == "scheduler_start":
             self._sched["started"] = True
+            if e.get("autoscale") is not None:
+                self._autoscale["policy"] = e["autoscale"]
         elif kind == "scheduler_stop":
             self._sched["stopped"] = True
         elif kind == "drain":
@@ -441,6 +451,33 @@ class LiveAggregate:
                     "value", "threshold", "t")}
             self._alerts[(rec["rule"], rec.get("job"))] = rec
             self._recent_alerts.append(rec)
+        elif kind == "autoscale_decision":
+            a = self._autoscale
+            a["decisions"] += 1
+            verdict = e.get("verdict")
+            if verdict == "filed":
+                a["filed"] += 1
+            elif verdict == "rejected":
+                a["rejected"] += 1
+            rec = {k: e.get(k) for k in
+                   ("job", "action", "verdict", "reason", "dims",
+                    "new_dims", "streak", "t")}
+            be = (e.get("pricing") or {}).get("break_even")
+            if be:
+                rec["break_even_steps"] = be.get("break_even_steps")
+                rec["net_gain_s"] = be.get("net_gain_s")
+            a["last"] = rec
+            self._autoscale_recent.append(rec)
+        elif kind == "job_resized" and name is not None:
+            self._autoscale["resizes"] += 1
+            job = self._job(name)
+            job["resizes"] = job.get("resizes", 0) + 1
+            if e.get("new_dims") is not None:
+                job["dims"] = e["new_dims"]
+        elif kind == "job_retuned" and name is not None:
+            self._autoscale["retunes"] += 1
+            self._job(name)["retunes"] = \
+                self._job(name).get("retunes", 0) + 1
 
     # -- barrier spreads (multi-process runs) ------------------------------
 
@@ -524,6 +561,8 @@ class LiveAggregate:
             "scheduler": dict(self._sched),
             "alerts": {"active": active,
                        "recent": list(self._recent_alerts)},
+            "autoscale": dict(self._autoscale,
+                              recent=list(self._autoscale_recent)),
             "gaps": list(self.gaps),
             "align": {str(k): v for k, v in self.align.items()},
         }

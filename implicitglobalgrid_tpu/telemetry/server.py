@@ -17,14 +17,20 @@ actually connects):
   heartbeat is older (a wedged driver stops heartbeating — the signal a
   supervisor restarts on).
 
-SECURITY: binds ``127.0.0.1`` by default — the endpoint is unauthenticated
-by design (it exposes only metrics), so reach it from elsewhere via an
-SSH tunnel or an authenticating reverse proxy rather than binding
-``0.0.0.0`` (see docs/observability.md).
+SECURITY: binds ``127.0.0.1`` by default. The bare /metrics + /healthz
+pair is unauthenticated by design (it exposes only metrics). Extended
+``routes`` surfaces (the serving tier's job API, observe plane, and
+snapshot query service) can require a bearer token: pass
+``auth_token=`` (the serve-tier servers default it from
+``IGG_API_TOKEN``) and every routed request must carry
+``Authorization: Bearer <token>`` — compared constant-time — or it is
+answered 401; /metrics and /healthz stay open for scrapers and
+supervisors (see docs/api.md).
 """
 
 from __future__ import annotations
 
+import hmac
 import json
 import threading
 import time
@@ -38,7 +44,27 @@ from .hooks import (
 from .registry import metrics_registry
 
 __all__ = ["MetricsServer", "start_metrics_server", "stop_metrics_server",
-           "metrics_server"]
+           "metrics_server", "resolve_api_token"]
+
+
+def resolve_api_token(api_token) -> str | None:
+    """The serve-tier servers' one token-resolution rule: ``None``
+    defers to the ``IGG_API_TOKEN`` environment variable (unset or
+    empty = unauthenticated), ``False`` forces an unauthenticated
+    server even with the variable set, and a string is the token
+    itself."""
+    import os
+
+    if api_token is False:
+        return None
+    if api_token is None:
+        return os.environ.get("IGG_API_TOKEN") or None
+    if not isinstance(api_token, str) or not api_token:
+        raise InvalidArgumentError(
+            "api_token must be a non-empty string, None (defer to "
+            "IGG_API_TOKEN), or False (explicitly unauthenticated); "
+            f"got {api_token!r}.")
+    return api_token
 
 
 class MetricsServer:
@@ -53,7 +79,10 @@ class MetricsServer:
     (code, body_bytes, ctype[, headers_dict]) | None`` — ``query`` is
     the RAW query string, ``body`` the request bytes (b"" for GET);
     return None to 404. Route exceptions answer a JSON 500 (the server
-    thread must survive any handler bug).
+    thread must survive any handler bug). ``auth_token`` gates the
+    routed surface: every routed request (GET and POST alike) must
+    carry ``Authorization: Bearer <token>`` or is answered 401 —
+    /metrics and /healthz stay open.
 
     A route may return an ITERATOR of bytes instead of a body — the
     response then streams as HTTP/1.1 chunked transfer, one chunk per
@@ -66,7 +95,7 @@ class MetricsServer:
 
     def __init__(self, port: int = 0, *, host: str = "127.0.0.1",
                  registry=None, healthz_max_age_s: float | None = None,
-                 routes=None):
+                 routes=None, auth_token: str | None = None):
         reg = registry if registry is not None else metrics_registry()
         max_age = None if healthz_max_age_s is None \
             else float(healthz_max_age_s)
@@ -74,6 +103,15 @@ class MetricsServer:
             raise InvalidArgumentError(
                 "MetricsServer routes must be callable "
                 "(method, path, query, body) -> response tuple or None.")
+        # bearer auth covers the ROUTED surface only: /metrics and
+        # /healthz stay open (scrapers and supervisors don't carry
+        # credentials); the comparison is constant-time so the token
+        # can't be recovered byte-by-byte from response timing
+        token = None if auth_token is None else str(auth_token)
+        if token == "":
+            raise InvalidArgumentError(
+                "auth_token must be a non-empty string (or None to "
+                "serve the routed surface unauthenticated).")
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -128,6 +166,19 @@ class MetricsServer:
                 if routes is None:
                     self._send(404, b"not found\n", "text/plain")
                     return
+                if token is not None:
+                    auth = self.headers.get("Authorization") or ""
+                    supplied = auth[7:].strip() \
+                        if auth.startswith("Bearer ") else ""
+                    if not hmac.compare_digest(supplied.encode("utf-8"),
+                                               token.encode("utf-8")):
+                        self._send(
+                            401, json.dumps(
+                                {"error": "missing or invalid bearer "
+                                          "token"}).encode(),
+                            "application/json",
+                            {"WWW-Authenticate": "Bearer"})
+                        return
                 try:
                     resp = routes(method, path, query, body)
                 except Exception as e:

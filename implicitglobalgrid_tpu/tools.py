@@ -595,6 +595,39 @@ def _cli(argv=None) -> int:
             prs.add_argument("--cpu", action="store_true",
                              help="run on the 8-device virtual CPU mesh "
                                   "(the bench scripts' convention)")
+        if what == "plan":
+            prs.add_argument("--nt-remaining", type=int, default=None,
+                             help="steps left in the job's horizon: "
+                                  "amortize the priced transfer against "
+                                  "them (needs --old-step-s and "
+                                  "--new-step-s; prints the same "
+                                  "break_even record the autoscaler and "
+                                  "service_report carry)")
+            prs.add_argument("--old-step-s", type=float, default=None,
+                             help="per-step seconds on the SOURCE dims "
+                                  "(e.g. predict_step or a measured "
+                                  "baseline)")
+            prs.add_argument("--new-step-s", type=float, default=None,
+                             help="per-step seconds on the DESTINATION "
+                                  "dims")
+    asp = sub.add_parser(
+        "autoscale", help="the closed-loop autoscaler's operator "
+                          "surface: reconstruct WHY the mesh resized "
+                          "itself from a scheduler journal alone")
+    as_sub = asp.add_subparsers(dest="autoscale_cmd", required=True)
+    ax = as_sub.add_parser(
+        "explain", help="every journaled autoscale_decision: the policy "
+                        "echo, verdict counts, rejection histogram, and "
+                        "each filed move's actuation chain "
+                        "(autoscale_decision -> control -> "
+                        "resize_requested -> job_resized -> job_retuned) "
+                        "with its full pricing breakdown")
+    ax.add_argument("flight_dir",
+                    help="MeshScheduler flight directory (or its "
+                         "scheduler.jsonl)")
+    ax.add_argument("--job", default=None,
+                    help="only this job's decisions and moves")
+    ax.add_argument("--indent", type=int, default=2)
     aud = sub.add_parser(
         "audit", help="static analysis of compiled programs: collective "
                       "contract + implicit-grid lints + perfmodel "
@@ -663,6 +696,8 @@ def _cli(argv=None) -> int:
         return _cli_audit(args)
     if args.cmd == "reshard":
         return _cli_reshard(args)
+    if args.cmd == "autoscale":
+        return _cli_autoscale(args)
     if args.cmd == "jobs":
         return _cli_jobs(args)
     if args.cmd == "tune":
@@ -1097,9 +1132,22 @@ def _cli_reshard(args) -> int:
                         src_dims[1] * nx, src_dims[2] * nx)
         fields[f"f{i}"] = (shape, str(np.dtype(args.dtype)), len(lead))
     plan = build_reshard_plan(topo, dst_dims, fields)
-    rec = {"plan": plan.to_json(), "predicted": predict_reshard(plan)}
+    pred = predict_reshard(plan)
+    rec = {"plan": plan.to_json(), "predicted": pred}
 
     if args.reshard_cmd == "plan":
+        be_args = (args.nt_remaining, args.old_step_s, args.new_step_s)
+        if any(a is not None for a in be_args):
+            if any(a is None for a in be_args):
+                raise InvalidArgumentError(
+                    "tools reshard plan: --nt-remaining, --old-step-s, "
+                    "and --new-step-s go together (the amortized "
+                    "break-even needs all three).")
+            # the one shared break-even arithmetic (telemetry.
+            # ReshardPrediction) — identical to what the autoscaler
+            # prices and service_report carries
+            rec["break_even"] = pred.amortized_break_even_steps(
+                args.nt_remaining, args.old_step_s, args.new_step_s)
         print(json.dumps(rec, indent=args.indent, default=str))
         return 0
 
@@ -1170,6 +1218,28 @@ def _cli_reshard(args) -> int:
             for f in a["findings"]:
                 print(f"  [{f['severity']}] {f['rule']}: {f['message']}")
     return 0 if ok else 1
+
+
+def _cli_autoscale(args) -> int:
+    """``autoscale explain``: the closed-loop autoscaler's
+    explainability contract (docs/autoscaling.md). Reconstructed from
+    the scheduler journal ALONE — a service that died hours ago still
+    defends every resize it made (and every one it refused): policy
+    echo, verdict counts, the rejection histogram, each filed move's
+    actuation chain with its signal snapshot and pricing breakdown.
+    ``--job`` narrows to one tenant."""
+    import json
+
+    from .service.report import explain_autoscale
+
+    rec = explain_autoscale(args.flight_dir)
+    if args.job is not None:
+        rec = {"policy": rec["policy"], "job": args.job,
+               "moves": [m for m in rec["moves"]
+                         if m.get("job") == args.job],
+               "decisions": rec["jobs"].get(args.job, [])}
+    print(json.dumps(rec, indent=args.indent, default=str))
+    return 0
 
 
 def _cli_jobs(args) -> int:

@@ -794,3 +794,79 @@ def test_alerts_fire_sink_cancels_bust_job_survivors_bit_identical(
         assert {(e["rule"], e.get("job")) for e in trans} == {
             ("deadline_slack_burn", "bust"),
             ("guard_trip_storm", "poked")}
+
+
+# ---------------------------------------------------------------------------
+# Bearer-token auth (ISSUE 19 satellite): the routed ops surface
+# ---------------------------------------------------------------------------
+
+def _get_code(url, token=None):
+    req = urllib.request.Request(url)
+    if token is not None:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_api_token_gates_routed_surface(tmp_path, monkeypatch):
+    """With a bearer token configured, every ROUTED endpoint of the three
+    front doors answers 401 without (or with a wrong) token and works
+    with the right one; /metrics and /healthz stay open for probes and
+    scrapers. The token comes from the ``api_token=`` argument or the
+    ``IGG_API_TOKEN`` environment; ``api_token=False`` forces an
+    unauthenticated server even with the env set."""
+    from implicitglobalgrid_tpu.serve import ObserveServer
+
+    monkeypatch.delenv("IGG_API_TOKEN", raising=False)
+    d = str(tmp_path / "svc")
+    with JobApiServer(d, api_token="s3cret") as api:
+        u = f"http://{api.host}:{api.port}"
+        assert _get_code(u + "/v1/jobs") == 401
+        assert _get_code(u + "/v1/jobs", token="wrong") == 401
+        assert _get_code(u + "/v1/jobs", token="s3cret") == 200
+        # the WWW-Authenticate challenge names the scheme
+        try:
+            urllib.request.urlopen(u + "/v1/jobs", timeout=10)
+        except urllib.error.HTTPError as e:
+            assert e.headers.get("WWW-Authenticate") == "Bearer"
+        # probes and scrapers stay open: not part of the routed surface
+        assert _get_code(u + "/metrics") == 200
+        assert _get_code(u + "/healthz") == 200
+        # mutating routes are gated too
+        req = urllib.request.Request(
+            u + "/v1/drain", data=b"", method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                code = r.status
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 401
+
+    # the env var is the deployment path; False force-disables
+    monkeypatch.setenv("IGG_API_TOKEN", "envtok")
+    with JobApiServer(d) as api:
+        u = f"http://{api.host}:{api.port}"
+        assert _get_code(u + "/v1/jobs") == 401
+        assert _get_code(u + "/v1/jobs", token="envtok") == 200
+    with JobApiServer(d, api_token=False) as api:
+        assert _get_code(f"http://{api.host}:{api.port}/v1/jobs") == 200
+    monkeypatch.delenv("IGG_API_TOKEN", raising=False)
+
+    # the read-side planes take the same token
+    with ObserveServer(d, api_token="obs") as obs:
+        u = f"http://{obs.host}:{obs.port}"
+        assert _get_code(u + "/v1/observe") == 401
+        assert _get_code(u + "/v1/observe", token="obs") == 200
+    root = tmp_path / "snaps"
+    root.mkdir()
+    with SnapshotQueryServer(str(root), api_token="q") as q:
+        u = f"http://{q.host}:{q.port}"
+        assert _get_code(u + "/v1/snapshots") == 401
+        assert _get_code(u + "/v1/snapshots", token="q") == 200
+
+    # an empty token is a misconfiguration, not an open server
+    with pytest.raises(InvalidArgumentError, match="token"):
+        JobApiServer(d, api_token="")
