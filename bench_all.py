@@ -228,6 +228,18 @@ def main() -> None:
     live_ok = next(r["value"] for r in live_rows
                    if r["metric"] == "live_tail_overhead_frac") < 0.02
 
+    # --- distributed tracing (ISSUE 20) -------------------------------------
+    # the recorder's per-event trace stamp (two dict inserts) as a
+    # fraction of the telemetry leg's off-run time, gated < 2%; the
+    # 10k-event OTLP export rides the perfdb trajectory. Config owned by
+    # `bench_telemetry.tracing_rows`.
+    tracing = bench_telemetry.tracing_rows(tel_ref["off_run_s_median"],
+                                           tel_ref["events_per_run"])
+    for row in tracing:
+        results.append(bench_util.emit(row))
+    tracing_ok = next(r["value"] for r in tracing
+                      if r["metric"] == "trace_ctx_overhead_frac") < 0.02
+
     # --- mesh observability: trace pipeline + server-off step-loop cost ----
     # aggregation+straggler+Perfetto-export wall time on a 10k-event
     # two-process stream (host-only, target < 5 s) and the deterministic
@@ -404,7 +416,7 @@ def main() -> None:
     if (not gate["ok"] or lint_failed or not coalesce8_ok
             or not ensemble_ok or not tuned_ok or not reshard_ok
             or not staged_ok or not serve_ok or not live_ok
-            or not autoscale_ok) \
+            or not autoscale_ok or not tracing_ok) \
             and os.environ.get("IGG_BENCH_STRICT") == "1":
         sys.exit(1)
 

@@ -263,6 +263,106 @@ def live_plane_rows(t_ref_s: float, n_boundaries: int = 3):
     return rows
 
 
+def tracing_rows(t_ref_s: float, n_events: int):
+    """Distributed tracing's cost (ISSUE 20), host-only:
+
+    - ``trace_ctx_overhead_frac`` (gated < 2%): the DETERMINISTIC
+      accounting — the recorder's trace stamp is two dict inserts per
+      event (`FlightRecorder.trace`), measured as the per-event delta
+      between a traced and an untraced recorder over interleaved
+      flushed-write probes, times the events a supervised run emits,
+      over the telemetry leg's off-run time. The delta is clamped at
+      zero: the stamp costs nanoseconds against a ~10 us flushed write,
+      so the raw difference (recorded alongside) can go negative under
+      machine jitter.
+    - ``otlp_export_s``: `export_otlp` wall time on a 10k-event traced
+      stream (journal-style minted span ids + flight-style synthesized
+      ones) — the post-hoc export an operator runs per incident; perfdb
+      trajectory, no absolute gate."""
+    import json
+    import statistics
+    import time
+
+    from implicitglobalgrid_tpu.telemetry import (
+        FlightRecorder, TraceContext, export_otlp,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="igg_bench_tracing_")
+    tr = TraceContext.new().child()  # the job root, as the scheduler sets
+    n_probe = 2000
+    seq = itertools.count()
+
+    def probe(trace):
+        rec = FlightRecorder(os.path.join(tmp, f"p{next(seq)}.jsonl"),
+                             run_id="probe")
+        rec.trace = trace
+        t0 = time.monotonic()
+        for i in range(n_probe):
+            rec.event("chunk", chunk=i, step_begin=0, step_end=4, n=4,
+                      ok=True, reasons=[], build_s=1e-3, exec_s=0.1)
+        dt = time.monotonic() - t0
+        rec.close()
+        return dt / n_probe
+
+    offs, ons = [], []
+    for r in range(5):  # alternating order cancels position bias
+        for trace, acc in ([(None, offs), (tr, ons)] if r % 2 == 0
+                           else [(tr, ons), (None, offs)]):
+            acc.append(probe(trace))
+    per_off = statistics.median(offs)
+    per_on = statistics.median(ons)
+    delta = per_on - per_off
+    rows = [{
+        "metric": "trace_ctx_overhead_frac",
+        "value": max(0.0, delta) * n_events / t_ref_s,
+        "unit": "fraction of run time, deterministic per-event "
+                "accounting (target < 0.02)",
+        "target": 0.02,
+        "per_event_off_s": per_off,
+        "per_event_traced_s": per_on,
+        "per_event_delta_s": delta,
+        "events_per_run": n_events,
+        "ref_run_s": t_ref_s,
+        "note": "the stamp is two dict inserts before a flushed JSONL "
+                "write; span ids are synthesized at export, never on "
+                "the hot path",
+    }]
+
+    # --- the post-hoc OTLP export on a 10k-event traced stream ---------
+    path = os.path.join(tmp, "otlp_stream.jsonl")
+    n_stream = 10_000
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "recorder_open", "wall": 5000.0,
+                            "t": 100.0, "run": "j", "pid": 1, "proc": 0,
+                            "seq": 0}) + "\n")
+        for i in range(n_stream):
+            e = {"t": 100.0 + 0.01 * i,
+                 "kind": "slice" if i % 2 == 0 else "chunk",
+                 "run": "j", "pid": 1, "proc": 0, "seq": i + 1,
+                 "trace_id": tr.trace_id, "parent_span_id": tr.span_id,
+                 "chunk": i, "exec_s": 0.005, "ok": True}
+            if i % 2 == 0:  # journal-style events mint their span id
+                e["span_id"] = f"{i + 1:016x}"
+            f.write(json.dumps(e) + "\n")
+    out = os.path.join(tmp, "spans.json")
+    t0 = time.monotonic()
+    export_otlp(path, out)
+    otlp_s = time.monotonic() - t0
+    with open(out) as f:
+        n_spans = sum(len(ss["spans"])
+                      for rs in json.load(f)["resourceSpans"]
+                      for ss in rs["scopeSpans"])
+    rows.append({
+        "metric": "otlp_export_s",
+        "value": otlp_s,
+        "unit": "s (export_otlp on a 10k-event traced stream: read + "
+                "encode + write)",
+        "events": n_stream + 1,
+        "spans": n_spans,
+    })
+    return rows
+
+
 def run_telemetry_overhead(dims, cpu: bool):
     """The canonical leg: init its own grid over ``dims``, measure,
     finalize, return the rows. Shared by this script's __main__ and
@@ -305,6 +405,10 @@ def main() -> None:
     n_chunks = next(r["nt"] // r["nt_chunk"] for r in rows
                     if r["metric"] == "telemetry_overhead_frac")
     for row in live_plane_rows(t_ref, n_boundaries=n_chunks):
+        bench_util.emit(row)
+    n_events = next(r["events_per_run"] for r in rows
+                    if r["metric"] == "telemetry_overhead_frac")
+    for row in tracing_rows(t_ref, n_events):
         bench_util.emit(row)
 
 

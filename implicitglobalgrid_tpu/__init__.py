@@ -83,6 +83,7 @@ from .telemetry import (
     load_machine_profile, save_machine_profile, predict_step,
     predict_reshard, calibrate_machine, perfdb_add, perfdb_check,
     TunedConfig, tune_config, save_tuned_config, load_tuned_config,
+    TraceContext, export_otlp, OtlpSpanExporter,
 )
 from .models.common import ensemble_partition_spec, ensemble_state
 from . import io
@@ -153,6 +154,8 @@ __all__ = [
     # straggler analysis, live metrics endpoint)
     "aggregate_flight", "aggregate_events", "straggler_report",
     "export_chrome_trace",
+    # distributed tracing (W3C trace context propagation + OTLP export)
+    "TraceContext", "export_otlp", "OtlpSpanExporter",
     "MetricsServer", "start_metrics_server", "stop_metrics_server",
     "metrics_server",
     # performance oracle (analytical cost model, calibration, drift

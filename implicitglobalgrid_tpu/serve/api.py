@@ -33,6 +33,12 @@ come free):
   same flight directory unless ``observe=False``): the derived-signal
   snapshot and the resumable chunked-NDJSON event stream.
 
+TRACING: every submit / cancel / resize accepts a W3C ``traceparent``
+request header (or mints a fresh trace), echoes it on the response, and
+stamps it into the queue record / control payload — the claiming
+scheduler threads it through every journal event and flight span of the
+job (`telemetry.tracectx`; export with ``tools trace --otlp``).
+
 SECURITY: inherits `MetricsServer`'s loopback-by-default bind, and the
 whole ``/v1`` surface — mutating AND read routes — can require a bearer
 token: pass ``api_token=`` (defaults from the ``IGG_API_TOKEN``
@@ -50,6 +56,7 @@ from ..service.backend import DirectoryBackend, QueueBackend
 from ..service.job import jobspec_from_json
 from ..service.report import is_service_dir, service_report
 from ..telemetry.server import MetricsServer, resolve_api_token
+from ..telemetry.tracectx import TraceContext
 from ..utils.exceptions import InvalidArgumentError
 
 __all__ = ["JobApiServer"]
@@ -127,11 +134,27 @@ class JobApiServer:
     # -- routing -----------------------------------------------------------
 
     @staticmethod
-    def _json(code: int, rec: dict):
-        return code, json.dumps(rec, default=str).encode(), \
-            "application/json"
+    def _json(code: int, rec: dict, headers: dict | None = None):
+        resp = (code, json.dumps(rec, default=str).encode(),
+                "application/json")
+        return resp + (headers,) if headers else resp
 
-    def _route(self, method: str, path: str, query: str, body: bytes):
+    @staticmethod
+    def _trace_ctx(headers) -> TraceContext:
+        """The request's trace context: a CHILD of the caller's
+        ``traceparent`` span, or a fresh root when the header is absent.
+        A malformed header RESTARTS the trace (the W3C-recommended
+        degradation) rather than failing the request."""
+        tp = headers.get("traceparent") if headers is not None else None
+        if tp:
+            try:
+                return TraceContext.parse(str(tp)).child()
+            except InvalidArgumentError:
+                pass
+        return TraceContext.new()
+
+    def _route(self, method: str, path: str, query: str, body: bytes,
+               headers=None):
         if self.observe is not None:
             resp = self.observe.routes(method, path, query, body)
             if resp is not None:
@@ -141,7 +164,7 @@ class JobApiServer:
             return self._json(202, {"requested": "drain"})
         if path in ("/v1/jobs", "/v1/jobs/"):
             if method == "POST":
-                return self._submit(body)
+                return self._submit(body, self._trace_ctx(headers))
             return self._json(200, {"jobs": self._jobs_view()})
         prefix = "/v1/jobs/"
         if not path.startswith(prefix):
@@ -157,12 +180,13 @@ class JobApiServer:
         if method == "POST" and len(rest) == 2 and rest[0] \
                 and rest[1] in ("cancel", "resize"):
             try:
-                return self._control(rest[0], rest[1], body)
+                return self._control(rest[0], rest[1], body,
+                                     self._trace_ctx(headers))
             except InvalidArgumentError as e:
                 return self._json(400, {"error": str(e)})
         return None
 
-    def _submit(self, body: bytes):
+    def _submit(self, body: bytes, ctx: TraceContext):
         try:
             doc = json.loads(body.decode("utf-8"))
         except (UnicodeDecodeError, json.JSONDecodeError) as e:
@@ -195,16 +219,27 @@ class JobApiServer:
                                    "exists on this service (names key "
                                    "journals and queue records)."})
             names.append(spec.name)
+        # the submit span's traceparent rides INSIDE each queue record:
+        # `DirectoryBackend` round-trips it verbatim and the claiming
+        # scheduler derives the job's root span from it — the causal
+        # thread from this HTTP request to every collective under it
+        tp = ctx.to_traceparent()
         for rec in records:
-            self.backend.submit(dict(rec))
-        return self._json(202, {"submitted": names})
+            rec = dict(rec)
+            rec["traceparent"] = tp
+            self.backend.submit(rec)
+        return self._json(202, {"submitted": names, "traceparent": tp},
+                          {"traceparent": tp})
 
-    def _control(self, name: str, verb: str, body: bytes):
+    def _control(self, name: str, verb: str, body: bytes,
+                 ctx: TraceContext):
+        tp = ctx.to_traceparent()
         if verb == "cancel" and self.backend.discard(name):
             # atomically beat every scheduler to the pending record —
             # the job never existed as far as any journal is concerned
             return self._json(202, {"requested": "cancel", "job": name,
-                                    "discarded": True})
+                                    "discarded": True},
+                              {"traceparent": tp})
         job = self._jobs_view().get(name)
         if job is None:
             return self._json(404, {"error": f"no job named {name!r}",
@@ -213,8 +248,9 @@ class JobApiServer:
             return self._json(409, {"error": f"job {name!r} already "
                                              f"{job['state']}"})
         if verb == "cancel":
-            self.backend.control("cancel", name)
-            return self._json(202, {"requested": "cancel", "job": name})
+            self.backend.control("cancel", name, {"traceparent": tp})
+            return self._json(202, {"requested": "cancel", "job": name},
+                              {"traceparent": tp})
         # resize
         try:
             req = json.loads(body.decode("utf-8")) if body else {}
@@ -239,6 +275,8 @@ class JobApiServer:
             return self._json(400, {"error": f"via must be auto|device|"
                                              f"checkpoint; got {via!r}"})
         self.backend.control("resize", name,
-                             {"new_dims": dims, "via": via})
+                             {"new_dims": dims, "via": via,
+                              "traceparent": tp})
         return self._json(202, {"requested": "resize", "job": name,
-                                "new_dims": dims, "via": via})
+                                "new_dims": dims, "via": via},
+                          {"traceparent": tp})

@@ -15,7 +15,9 @@ served standalone by `ObserveServer`:
   ``live_seq`` high-water mark to resume the event stream from.
 - ``GET /v1/events?since=<seq>`` — the merged, clock-aligned live event
   feed as chunked NDJSON: every line one flight event (``live_seq``
-  stamped), heartbeat lines (``{"kind": "heartbeat", "cursor": n}``)
+  stamped), heartbeat lines (``{"kind": "heartbeat", "cursor": n,
+  "server_ts": unix_s, "last_seq": m}`` — ``last_seq`` ahead of the
+  client's cursor means a stalled tail, not a quiet mesh)
   while idle so consumers distinguish quiet from dead, bounded by
   ``timeout_s`` per request. RESUMABLE: each response ends with a final
   heartbeat carrying the cursor; pass it back as ``since=`` and only
@@ -183,9 +185,13 @@ class ObservePlane:
                 last_emit = now
             time.sleep(min(_POLL_SLEEP_S, max(0.0, deadline - now)))
 
-    @staticmethod
-    def _hb(cursor, done: bool = False) -> bytes:
-        rec = {"kind": "heartbeat", "cursor": cursor}
+    def _hb(self, cursor, done: bool = False) -> bytes:
+        # server_ts + last_seq let a stream client tell "quiet mesh"
+        # (last_seq == its cursor, server_ts advancing) from "stalled
+        # tail" (last_seq ahead of what it received) — tools watch
+        # surfaces the same lag
+        rec = {"kind": "heartbeat", "cursor": cursor,
+               "server_ts": time.time(), "last_seq": self.live.cursor}
         if done:
             rec["done"] = True
         return json.dumps(rec).encode() + b"\n"

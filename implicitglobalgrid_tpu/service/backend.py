@@ -17,7 +17,8 @@ scheduler sharing the load — talks to the scheduler through a
 
 ``control/``
     The PR-8 control-file protocol, verbatim: ``drain`` (empty file),
-    ``cancel_<name>`` (empty file), ``resize_<name>`` (JSON payload
+    ``cancel_<name>`` (empty file; may carry an optional JSON payload —
+    requester trace context), ``resize_<name>`` (JSON payload
     ``{"new_dims": [...], "via": ...}``). ``.tmp`` staging files are
     skipped; consuming a request removes the file.
 
@@ -58,7 +59,8 @@ class QueueBackend:
     def control(self, request: str, job: str | None = None,
                 payload: dict | None = None) -> None:
         """File one control request: ``drain`` | ``cancel`` (needs
-        ``job``) | ``resize`` (needs ``job`` + ``payload``)."""
+        ``job``; ``payload`` optional — e.g. the requester's trace
+        context) | ``resize`` (needs ``job`` + ``payload``)."""
         raise NotImplementedError
 
     # -- consumer side -----------------------------------------------------
@@ -96,7 +98,7 @@ class QueueBackend:
     def poll_control(self) -> list:
         """Consume every complete control request, in filing order.
         Returns dicts: ``{"request": "drain"}``,
-        ``{"request": "cancel", "job": name}``,
+        ``{"request": "cancel", "job": name, "payload": dict|None}``,
         ``{"request": "resize", "job": name, "payload": dict|None}``
         (payload None = unreadable file — the scheduler journals the
         rejection; never drop an operator request silently)."""
@@ -162,9 +164,16 @@ class DirectoryBackend(QueueBackend):
                 f"control({request!r}) needs a slash-free job name; "
                 f"got {job!r}.")
         if request == "cancel":
+            # the PR-8 protocol's empty file stays valid; an optional
+            # JSON payload (e.g. the requesting span's traceparent, or
+            # the alert that decided the cancel) rides in the body and
+            # old consumers that ignore content are unaffected
             path = os.path.join(self.control_dir, f"cancel_{job}")
-            with open(path, "w", encoding="utf-8"):
-                pass
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                if payload is not None:
+                    json.dump(payload, f)
+            os.replace(tmp, path)
         elif request == "resize":
             if not isinstance(payload, dict):
                 raise InvalidArgumentError(
@@ -252,9 +261,20 @@ class DirectoryBackend(QueueBackend):
                 os.remove(path)
                 out.append({"request": "drain"})
             elif fname.startswith("cancel_"):
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        raw = f.read().strip()
+                    req = json.loads(raw) if raw else None
+                except Exception:
+                    req = None  # empty/foreign body = legacy cancel
                 os.remove(path)
-                out.append({"request": "cancel",
-                            "job": fname[len("cancel_"):]})
+                rec = {"request": "cancel",
+                       "job": fname[len("cancel_"):]}
+                if isinstance(req, dict):
+                    # only a filed JSON body surfaces — a legacy empty
+                    # cancel keeps its exact PR-8 wire shape
+                    rec["payload"] = req
+                out.append(rec)
             elif fname.startswith("resize_"):
                 try:
                     with open(path, encoding="utf-8") as f:

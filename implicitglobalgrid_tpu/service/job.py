@@ -128,6 +128,7 @@ class Job:
         self.resize_requested = None    # (dims tuple, via); applied at a slice
         self.last_end_t: float | None = None
         self.deadline_logged = False    # deadline_missed journaled once
+        self.trace = None               # job-root TraceContext (or None)
 
     @property
     def name(self) -> str:
@@ -346,6 +347,10 @@ def jobspec_from_json(rec: dict, *, where: str = "job record") -> JobSpec:
             f"{where}: a job record must be a JSON object; got "
             f"{type(rec).__name__}.")
     rec = dict(rec)
+    # transport envelope, not a job knob: the submit span's W3C header
+    # the API stamped into the record (the claiming scheduler reads it
+    # off the RAW record; the spec itself stays trace-free)
+    rec.pop("traceparent", None)
     missing = [k for k in ("name", "model", "nt") if k not in rec]
     if missing:
         raise InvalidArgumentError(

@@ -220,7 +220,13 @@ def service_report(source, *, include_jobs: bool = True) -> dict:
         elif k == "job_claimed":
             # the record arrived through a queue backend (CLI drop /
             # HTTP POST) — who claimed it, for multi-scheduler forensics
-            rec(e["job"])["claimed_by"] = e.get("owner")
+            r = rec(e["job"])
+            r["claimed_by"] = e.get("owner")
+            if e.get("trace_id") is not None:
+                # the distributed-trace identity the submitter's
+                # traceparent seeded — the handle for export_otlp
+                # --trace-id / any collector query
+                r["trace_id"] = e.get("trace_id")
         elif k == "admission_priced":
             # the deadline-admission verdict WITH its pricing inputs —
             # the journal defends every reject (and every admit)

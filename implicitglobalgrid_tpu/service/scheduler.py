@@ -52,6 +52,7 @@ from ..runtime.driver import ResilientRun
 from ..telemetry import hooks
 from ..telemetry.live import AlertEngine
 from ..telemetry.recorder import FlightRecorder, use_flight_recorder
+from ..telemetry.tracectx import TraceContext
 from ..utils.exceptions import InvalidArgumentError
 from .autoscale import Autoscaler, AutoscalePolicy
 from .backend import DirectoryBackend, QueueBackend
@@ -173,6 +174,12 @@ class MeshScheduler:
             raise InvalidArgumentError(
                 "alert_sinks without alerts: pass alerts=True (default "
                 "rule pack), a rule list, or an AlertEngine.")
+        if self.alert_engine is not None \
+                and getattr(self.alert_engine, "tracer", None) is None:
+            # alert transitions join the affected job's trace (a fresh
+            # child span) BEFORE journal+sinks, so an alert-driven
+            # control action can carry the alert's span as its parent
+            self.alert_engine.tracer = self._alert_trace
         # the closed-loop autoscaler (ISSUE 19): ``autoscale=True`` turns
         # on the default policy, an AutoscalePolicy (or its kwargs dict)
         # customizes it, a ready Autoscaler is adopted as-is. It
@@ -226,15 +233,30 @@ class MeshScheduler:
     # -- journal -----------------------------------------------------------
 
     def _log(self, kind: str, **fields) -> None:
-        if self._journal is not None:
-            self._journal.event(kind, **fields)
+        if self._journal is None:
+            return
+        # the ONE trace-stamping chokepoint: every job-scoped journal
+        # event (claim, admission verdict, slices, resize chains, alert
+        # transitions, state changes) becomes a fresh CHILD span of the
+        # job's root context. Explicit trace fields in the call win;
+        # untraced jobs journal byte-identically to before.
+        if "trace_id" not in fields and fields.get("job") is not None:
+            job = self.jobs.get(fields["job"])
+            tr = getattr(job, "trace", None)
+            if tr is not None:
+                fields.update(tr.child().fields())
+        self._journal.event(kind, **fields)
 
     # -- submission --------------------------------------------------------
 
-    def submit(self, spec: JobSpec) -> Job:
+    def submit(self, spec: JobSpec, *,
+               trace: TraceContext | None = None) -> Job:
         """Queue one job. Admission (grid + state construction) is LAZY —
         it happens inside the job's first granted slice, so its cost is
-        attributed to the job that pays it, not to the submitter."""
+        attributed to the job that pays it, not to the submitter.
+        ``trace`` is the job's ROOT span (`telemetry.tracectx`) — set by
+        the queue-claim path from the record's ``traceparent``; every
+        journal event and flight span of the job becomes its child."""
         self._check_open()
         if not isinstance(spec, JobSpec):
             raise InvalidArgumentError(
@@ -247,6 +269,7 @@ class MeshScheduler:
             raise InvalidArgumentError(
                 "The scheduler is draining — no new admissions.")
         job = Job(spec, self._n_submitted)
+        job.trace = trace
         self._n_submitted += 1
         job.submitted_t = time.time()
         job.last_end_t = time.monotonic()
@@ -500,15 +523,26 @@ class MeshScheduler:
                 self._log("control", request="drain")
                 self.drain()
             elif kind == "cancel":
-                name = req["job"]
-                self._log("control", request="cancel", job=name)
+                name, payload = req["job"], req.get("payload")
+                # a cancel filed WITH a trace (the HTTP API's request
+                # span, or the alert span a ControlFileSink acted on)
+                # parents the control event — "why was my job
+                # cancelled" is one trace walk back to the decider
+                ctx = self._parse_traceparent(payload)
+                self._log("control", request="cancel", job=name,
+                          **(ctx.fields() if ctx is not None else {}))
                 job = self.jobs.get(name)
                 if job is not None and not job.finished:
                     self.cancel(name)
             elif kind == "resize":
                 name, payload = req["job"], req.get("payload")
+                ctx = self._parse_traceparent(payload)
+                if isinstance(payload, dict):
+                    payload = {k: v for k, v in payload.items()
+                               if k != "traceparent"}
                 self._log("control", request="resize", job=name,
-                          payload=payload)
+                          payload=payload,
+                          **(ctx.fields() if ctx is not None else {}))
                 job = self.jobs.get(name)
                 if job is None or job.finished \
                         or not isinstance(payload, dict):
@@ -527,6 +561,27 @@ class MeshScheduler:
                     # not take the scheduler (and every tenant) down
                     self._log("resize_rejected", job=name, error=str(e))
 
+    def _alert_trace(self, transition: dict) -> dict:
+        """`AlertEngine.tracer` hook: the transition as a child span of
+        the affected job's trace (empty for untraced/unattributed)."""
+        job = self.jobs.get(transition.get("job"))
+        tr = getattr(job, "trace", None)
+        return tr.child().fields() if tr is not None else {}
+
+    @staticmethod
+    def _parse_traceparent(rec) -> TraceContext | None:
+        """A queue record's / control payload's ``traceparent`` as a
+        fresh CHILD context of the requester's span; None when absent or
+        malformed (a bad header degrades to an untraced job — it never
+        rejects work)."""
+        tp = rec.get("traceparent") if isinstance(rec, dict) else None
+        if not tp:
+            return None
+        try:
+            return TraceContext.parse(str(tp)).child()
+        except InvalidArgumentError:
+            return None
+
     def _poll_queue(self) -> None:
         """Claim at most ONE pending record from the queue backend per
         scheduling decision — claims interleave with slices, so N
@@ -543,8 +598,13 @@ class MeshScheduler:
             self._log("submit_rejected", job=name,
                       error=claimed.get("error") or "unreadable record")
             return
+        # the record's traceparent (the API's submit span) becomes the
+        # job's ROOT context: job_claimed IS the root span, its parent
+        # the HTTP submit — one connected tree from request to slices
+        trace = self._parse_traceparent(claimed["record"])
         self._log("job_claimed", job=name,
-                  owner=getattr(self.queue, "owner", None))
+                  owner=getattr(self.queue, "owner", None),
+                  **(trace.fields() if trace is not None else {}))
         try:
             spec = jobspec_from_json(claimed["record"],
                                      where=f"queue record {name!r}")
@@ -552,7 +612,7 @@ class MeshScheduler:
                 raise InvalidArgumentError(
                     f"queue record {name!r} names job {spec.name!r} — "
                     "the record key and its 'name' must agree.")
-            self.submit(spec)
+            self.submit(spec, trace=trace)
         except InvalidArgumentError as e:
             # a malformed record must not take the scheduler (and every
             # tenant) down — journal the rejection and keep serving
@@ -588,6 +648,9 @@ class MeshScheduler:
             job.recorder = FlightRecorder(
                 os.path.join(self.flight_dir, f"job_{job.name}.jsonl"),
                 run_id=job.name)
+            # every driver event of this job (run/chunk/guard_trip/
+            # resize) joins the job's trace as a child of its root span
+            job.recorder.trace = job.trace
         run_spec = job.spec.run
         tuned = resolve_tuned(run_spec.tuned)
         if tuned is not None and run_spec.ensemble is None \

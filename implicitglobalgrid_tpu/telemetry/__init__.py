@@ -29,6 +29,13 @@ reference's whole surface, SURVEY §5.4):
 - `trace_export` — `export_chrome_trace`: the merged stream as
   Chrome/Perfetto trace-event JSON (one track per process, chunk/
   checkpoint/snapshot spans, instant guard events, counter tracks).
+- `tracectx` / `otlp` — END-TO-END distributed tracing (ISSUE 20
+  tentpole): `TraceContext` is the W3C ``traceparent``-compatible
+  causal identity the serve tier mints per request and the scheduler
+  threads through every journal event and flight span of a job;
+  `export_otlp` renders the merged streams as OTLP/HTTP JSON
+  ``ResourceSpans`` for any OpenTelemetry collector and
+  `OtlpSpanExporter` is the batched live sink.
 - `server` — `start_metrics_server`: opt-in stdlib HTTP thread serving
   ``/metrics`` (Prometheus exposition) and ``/healthz`` (driver
   heartbeat age); started by `run_resilient(metrics_port=...)`; routes
@@ -106,7 +113,9 @@ from .server import (
     MetricsServer, metrics_server, start_metrics_server,
     stop_metrics_server,
 )
+from .otlp import OtlpSpanExporter, export_otlp
 from .trace_export import export_chrome_trace
+from .tracectx import TraceContext
 
 __all__ = [
     "MetricsRegistry", "ScopedRegistry", "Counter", "Gauge", "Histogram",
@@ -116,6 +125,7 @@ __all__ = [
     "prometheus_snapshot", "run_report",
     "aggregate_flight", "aggregate_events", "straggler_report",
     "mesh_section", "export_chrome_trace",
+    "TraceContext", "export_otlp", "OtlpSpanExporter",
     "MetricsServer", "start_metrics_server", "stop_metrics_server",
     "metrics_server",
     "note_runner_cache", "account_halo_exchange", "observe_checkpoint",

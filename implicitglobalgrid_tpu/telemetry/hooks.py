@@ -25,7 +25,8 @@ __all__ = ["note_runner_cache", "account_halo_exchange",
            "note_job_transition", "observe_member_health",
            "observe_reshard", "note_deadline_slack", "note_queue_backlog",
            "note_alert", "note_autoscale_decision",
-           "note_job_target_devices"]
+           "note_job_target_devices", "note_http_request",
+           "note_flight_file_bytes"]
 
 # Metric family names (the exported contract; see docs/observability.md).
 RUNNER_CACHE = "igg_runner_cache_total"
@@ -89,6 +90,11 @@ AUTOSCALE_DECISIONS = "igg_autoscale_decisions_total"
 AUTOSCALE_RESIZES = "igg_autoscale_resizes_total"
 AUTOSCALE_REJECTED = "igg_autoscale_rejected_total"
 JOB_TARGET_DEVICES = "igg_job_target_devices"
+# serving-tier self-measurement (ISSUE 20): HTTP access telemetry on
+# every routed surface + flight-file growth from the tail checkpoints
+HTTP_REQUESTS = "igg_http_requests_total"
+HTTP_REQUEST_SECONDS = "igg_http_request_seconds"
+FLIGHT_FILE_BYTES = "igg_flight_file_bytes"
 
 
 def runner_cache_misses() -> float:
@@ -557,3 +563,33 @@ def observe_reducers(step, values: dict, *, ok: bool = True) -> None:
         elif not hasattr(v, "__len__"):
             g.set(float(v), name=name)
     record_event("reducers", step=step, ok=ok, values=values)
+
+
+def note_http_request(route: str, method: str, code: int,
+                      dur_s: float, scope=None) -> None:
+    """Account one routed HTTP request on the serving tier
+    (`telemetry.server.MetricsServer` dispatch — token-gate 401s
+    included).  ``route`` is the NORMALIZED route pattern (job names
+    collapsed to ``{name}``), keeping label cardinality bounded;
+    ``scope`` routes into the registry the answering server serves."""
+    reg = scope if scope is not None else metrics_registry()
+    reg.counter(
+        HTTP_REQUESTS,
+        "Routed HTTP requests by route pattern, method, and status code.",
+        ("route", "method", "code")).inc(
+            1, route=str(route), method=str(method), code=str(int(code)))
+    reg.histogram(
+        HTTP_REQUEST_SECONDS,
+        "Routed HTTP request handling wall time.", ("route",)
+    ).observe(float(dur_s), route=str(route))
+
+
+def note_flight_file_bytes(file: str, nbytes: int) -> None:
+    """Stamp one flight/journal stream's on-disk size (gauge, labeled by
+    basename) — fed from the live tail's byte-offset checkpoints
+    (`telemetry.live.FlightTail`), so recorder growth is visible before
+    it becomes a disk incident (``tools flight du`` is the CLI twin)."""
+    metrics_registry().gauge(
+        FLIGHT_FILE_BYTES,
+        "Bytes consumed so far by each flight/journal JSONL stream.",
+        ("file",)).set(int(nbytes), file=str(file))

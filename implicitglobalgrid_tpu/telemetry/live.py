@@ -61,7 +61,7 @@ from statistics import median
 
 from ..utils.exceptions import InvalidArgumentError
 from .aggregate import _resolve_paths, aggregate_events
-from .hooks import note_alert
+from .hooks import note_alert, note_flight_file_bytes
 from .perfmodel import robust_z
 from .recorder import read_flight_events
 
@@ -133,6 +133,10 @@ class FlightTail:
                 self._offsets[p] = size
                 continue
             self._offsets[p] = new_off
+            # disk hygiene rides the tail checkpoint: each stream's
+            # on-disk size as a gauge, so recorder growth is visible
+            # (tools flight du is the CLI twin)
+            note_flight_file_bytes(os.path.basename(p), size)
             for e in evs:
                 if self.run_id is not None \
                         and e.get("run") != self.run_id:
@@ -209,6 +213,7 @@ class LiveAggregate:
                            "rejected": 0, "resizes": 0, "retunes": 0,
                            "last": None}
         self._autoscale_recent: deque = deque(maxlen=32)
+        self._last_event_t = None      # newest aligned wall stamp seen
         self.align: dict = {}          # run id -> alignment metadata
 
     # -- tail + alignment --------------------------------------------------
@@ -240,6 +245,10 @@ class LiveAggregate:
             self._live_seq += 1
             self._consume(e)
             self._buffer.append(e)
+            if e.get("t") is not None:
+                t = float(e["t"])
+                if self._last_event_t is None or t > self._last_event_t:
+                    self._last_event_t = t
         if self.backend is not None:
             try:
                 self._queue["pending"] = self.backend.pending_count()
@@ -555,6 +564,16 @@ class LiveAggregate:
         return {
             "t": time.time(),
             "cursor": self.cursor,
+            # tail freshness: the aligned stamps are wall clock, so the
+            # age of the newest merged event distinguishes "quiet mesh"
+            # (small, creeping) from "stalled tail" (growing unbounded)
+            # — the local twin of /v1/events heartbeats' last_seq
+            "tail": {
+                "events_read": self.tail.events_read,
+                "last_event_t": self._last_event_t,
+                "lag_s": (max(0.0, time.time() - self._last_event_t)
+                          if self._last_event_t is not None else None),
+            },
             "jobs": jobs,
             "procs": procs,
             "queue": dict(self._queue),
@@ -741,12 +760,22 @@ class ControlFileSink:
         if key in self._seen:
             return
         self._seen.add(key)
+        # the alert's own span (stamped by the engine's tracer) rides in
+        # the control payload as its traceparent: the scheduler parents
+        # the consumed control event on the alert that decided it
+        trace = None
+        if transition.get("trace_id") and transition.get("span_id"):
+            trace = {"traceparent": f"00-{transition['trace_id']}-"
+                                    f"{transition['span_id']}-01"}
         if self.action == "drain":
             self.backend.control("drain")
+        elif self.action == "resize":
+            payload = dict(self.payload)
+            if trace:
+                payload.update(trace)
+            self.backend.control("resize", str(job), payload)
         else:
-            self.backend.control(self.action, str(job),
-                                 self.payload if self.action == "resize"
-                                 else None)
+            self.backend.control("cancel", str(job), trace)
         self.filed.append({"rule": transition.get("rule"), "job": job,
                            "action": self.action})
 
@@ -808,6 +837,12 @@ class AlertEngine:
         self.sinks = list(sinks)
         self.journal = journal
         self.registry = registry
+        # optional distributed-trace hook: callable(transition) -> trace
+        # field dict, applied BEFORE journal + sinks so the alert's span
+        # is known to both (the scheduler wires its per-job contexts
+        # here; a ControlFileSink then files the alert's span as the
+        # cancel's parent — "why was my job cancelled" is a trace walk)
+        self.tracer = None
         self._state: dict = {}
         self.transitions = 0
         self.evaluations = 0
@@ -928,6 +963,13 @@ class AlertEngine:
                 "threshold": rule.threshold, "t": t}
 
     def _deliver(self, tr: dict) -> None:
+        if self.tracer is not None:
+            try:
+                tf = self.tracer(tr)
+            except Exception:
+                tf = None  # tracing must never block alert delivery
+            if tf:
+                tr.update(tf)
         note_alert(tr["rule"], tr["severity"], tr["state"])
         if self.journal is not None:
             self.journal("alert", **{k: v for k, v in tr.items()

@@ -100,6 +100,12 @@ class FlightRecorder:
         self._pid = os.getpid()
         self._proc = _process_index()
         self._seq = 0
+        # optional distributed-trace context (`telemetry.tracectx`): when
+        # set, every record is stamped with the trace id and the owning
+        # span — one dict update per event, ids synthesized at export
+        # (`telemetry.otlp`). None (the default) changes NOTHING: records
+        # are byte-identical to an untraced recorder's.
+        self.trace = None
         self._f = open(path, "a", encoding="utf-8")
         self.event("recorder_open", wall=time.time(),
                    version=_FORMAT_VERSION)
@@ -108,6 +114,10 @@ class FlightRecorder:
         """Append one record. Reserved keys (``t``, ``kind``, ``run``,
         ``pid``, ``proc``, ``seq``) always win over ``fields``."""
         rec = dict(fields)
+        tr = self.trace
+        if tr is not None:
+            rec.setdefault("trace_id", tr.trace_id)
+            rec.setdefault("parent_span_id", tr.span_id)
         rec["t"] = time.monotonic()
         rec["kind"] = str(kind)
         rec["run"] = self.run_id

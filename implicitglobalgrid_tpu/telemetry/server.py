@@ -31,6 +31,7 @@ supervisors (see docs/api.md).
 from __future__ import annotations
 
 import hmac
+import inspect
 import json
 import threading
 import time
@@ -40,11 +41,42 @@ from ..utils.exceptions import InvalidArgumentError
 from .export import prometheus_snapshot
 from .hooks import (
     HEARTBEAT_STEP, HEARTBEAT_TS, JOB_HEARTBEAT_TS, SCHED_HEARTBEAT_TS,
+    note_http_request,
 )
 from .registry import metrics_registry
 
 __all__ = ["MetricsServer", "start_metrics_server", "stop_metrics_server",
            "metrics_server", "resolve_api_token"]
+
+
+def _route_label(path: str) -> str:
+    """Bounded-cardinality route label: the third path segment of a
+    ``/v1/...`` route is where job/resource NAMES live (``/v1/jobs/x``,
+    ``/v1/jobs/x/cancel``) — collapse it to ``{name}`` so the
+    ``igg_http_requests_total`` label set stays one series per route
+    pattern, not per tenant."""
+    segs = path.strip("/").split("/")
+    if len(segs) >= 3 and segs[0] == "v1":
+        segs[2] = "{name}"
+        return "/" + "/".join(segs)
+    return path
+
+
+def _routes_take_headers(fn) -> bool:
+    """Back-compat probe: does the ``routes`` callable accept a 5th
+    positional argument (the request headers)?  Older 4-arg routes keep
+    working unchanged — the traceparent-aware serve tier opts in."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    n = 0
+    for p in sig.parameters.values():
+        if p.kind == p.VAR_POSITIONAL:
+            return True
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            n += 1
+    return n >= 5
 
 
 def resolve_api_token(api_token) -> str | None:
@@ -78,7 +110,13 @@ class MetricsServer:
     this server): a callable ``(method, path, query, body) ->
     (code, body_bytes, ctype[, headers_dict]) | None`` — ``query`` is
     the RAW query string, ``body`` the request bytes (b"" for GET);
-    return None to 404. Route exceptions answer a JSON 500 (the server
+    return None to 404. A routes callable declaring a FIFTH positional
+    parameter additionally receives the request headers (a mapping with
+    ``.get``) — how the job API reads ``traceparent``; 4-arg routes are
+    untouched. Every request is accounted in
+    ``igg_http_requests_total{route,method,code}`` and the
+    ``igg_http_request_seconds`` histogram (route label collapsed to
+    its pattern, token-gate 401s included) in THIS server's registry. Route exceptions answer a JSON 500 (the server
     thread must survive any handler bug). ``auth_token`` gates the
     routed surface: every routed request (GET and POST alike) must
     carry ``Authorization: Bearer <token>`` or is answered 401 —
@@ -112,6 +150,7 @@ class MetricsServer:
             raise InvalidArgumentError(
                 "auth_token must be a non-empty string (or None to "
                 "serve the routed surface unauthenticated).")
+        takes_headers = routes is not None and _routes_take_headers(routes)
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -125,6 +164,7 @@ class MetricsServer:
 
             def _send(self, code: int, body: bytes, ctype: str,
                       headers: dict | None = None) -> None:
+                self._resp_code = int(code)
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -135,6 +175,7 @@ class MetricsServer:
 
             def _stream(self, code: int, chunks, ctype: str,
                         headers: dict | None = None) -> None:
+                self._resp_code = int(code)
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Transfer-Encoding", "chunked")
@@ -180,7 +221,9 @@ class MetricsServer:
                             {"WWW-Authenticate": "Bearer"})
                         return
                 try:
-                    resp = routes(method, path, query, body)
+                    resp = routes(method, path, query, body,
+                                  self.headers) if takes_headers \
+                        else routes(method, path, query, body)
                 except Exception as e:
                     # a handler bug answers 500; the thread survives
                     self._send(500, json.dumps(
@@ -200,6 +243,7 @@ class MetricsServer:
                     self._stream(int(code), payload, ctype, headers)
 
             def do_GET(self):
+                t0 = time.monotonic()
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
                     body = prometheus_snapshot(reg).encode()
@@ -211,14 +255,29 @@ class MetricsServer:
                                "application/json")
                 else:
                     self._route("GET", b"")
+                self._account("GET", path, t0)
 
             def do_POST(self):
+                t0 = time.monotonic()
                 try:
                     n = int(self.headers.get("Content-Length") or 0)
                 except ValueError:
                     n = 0
                 body = self.rfile.read(n) if n > 0 else b""
                 self._route("POST", body)
+                self._account("POST", self.path.partition("?")[0], t0)
+
+            def _account(self, method: str, path: str, t0: float) -> None:
+                # access telemetry for EVERY answered request (401s from
+                # the token gate included); a streamed response accounts
+                # its full stream lifetime. Never fails the request.
+                try:
+                    note_http_request(
+                        _route_label(path), method,
+                        getattr(self, "_resp_code", 0),
+                        time.monotonic() - t0, scope=reg)
+                except Exception:
+                    pass
 
         self.registry = reg
         self.healthz_max_age_s = max_age

@@ -104,17 +104,28 @@ def _track_meta(trace: list, pid: int, name: str) -> None:
                   "name": "thread_name", "args": {"name": "io-writer"}})
 
 
-def export_chrome_trace(source, out=None, *, run_id: str | None = None):
+def export_chrome_trace(source, out=None, *, run_id: str | None = None,
+                        trace_id: str | None = None):
     """Render ``source`` as Chrome trace-event JSON.
 
     ``source``: an `aggregate_flight` result, a directory of per-process
     ``*.jsonl`` streams (aggregated here), a list of stream paths, one
     JSONL path, or an iterable of (already merged) event dicts.
 
+    ``trace_id`` filters to the events stamped with ONE distributed
+    trace (`telemetry.tracectx` — the causal slice of a single request
+    on a Perfetto timeline; OTLP export is the span-tree view).
+
     With ``out`` (a path), writes the JSON there and returns the path;
     otherwise returns the trace dict (``{"traceEvents": [...], ...}``).
     Open the file at https://ui.perfetto.dev or ``chrome://tracing``."""
     events, agg = _normalize(source, run_id)
+    if trace_id is not None:
+        events = [e for e in events if e.get("trace_id") == trace_id]
+        if not events:
+            raise InvalidArgumentError(
+                f"export_chrome_trace: no events carry trace_id "
+                f"{trace_id!r}.")
     if not events:
         raise InvalidArgumentError("export_chrome_trace: no events.")
     # rebase to the earliest point on the timeline — span STARTS included
@@ -143,6 +154,8 @@ def export_chrome_trace(source, out=None, *, run_id: str | None = None):
             "processes": procs,
         },
     }
+    if trace_id is not None:
+        doc["otherData"]["trace_id"] = trace_id
     if agg is not None:
         doc["otherData"]["run_id"] = agg.get("run_id")
         doc["otherData"]["offsets"] = {

@@ -416,6 +416,16 @@ def _cli(argv=None) -> int:
                          "stream files themselves")
     tp.add_argument("-o", "--out", default="trace.json")
     tp.add_argument("--run-id", default=None)
+    tp.add_argument("--otlp", action="store_true",
+                    help="emit OTLP/HTTP JSON ResourceSpans (the span-"
+                         "tree view any OpenTelemetry collector ingests) "
+                         "instead of Perfetto trace-event JSON")
+    tp.add_argument("--trace-id", default=None,
+                    help="filter to ONE distributed trace (32-hex id "
+                         "from a traceparent) — the causal slice of a "
+                         "single request")
+    tp.add_argument("--job", default=None,
+                    help="with --otlp: filter to one job's spans")
     stp = sub.add_parser(
         "stragglers", help="cross-process straggler & imbalance report")
     stp.add_argument("src", nargs="+",
@@ -454,6 +464,17 @@ def _cli(argv=None) -> int:
                     help="acknowledge an alert (recorded in the side "
                          "file alerts_ack.json, never in the journal)")
     al.add_argument("--json", action="store_true")
+    fl = sub.add_parser(
+        "flight", help="flight-directory hygiene (disk usage of the "
+                       "recorder streams)")
+    fl_sub = fl.add_subparsers(dest="flight_cmd", required=True)
+    fdu = fl_sub.add_parser(
+        "du", help="per-stream on-disk bytes of a flight directory, "
+                   "largest first — recorder growth before it becomes "
+                   "an incident (the igg_flight_file_bytes gauges are "
+                   "the live twin)")
+    fdu.add_argument("flight_dir")
+    fdu.add_argument("--json", action="store_true")
     pdb = sub.add_parser(
         "perfdb", help="perf-history database: append bench runs, gate "
                        "regressions vs the trailing window")
@@ -706,6 +727,8 @@ def _cli(argv=None) -> int:
         return _cli_watch(args)
     if args.cmd == "alerts":
         return _cli_alerts(args)
+    if args.cmd == "flight":
+        return _cli_flight(args)
 
     from .telemetry import prometheus_snapshot, run_report
 
@@ -788,7 +811,32 @@ def _cli(argv=None) -> int:
         from .telemetry import export_chrome_trace
 
         src = _agg_source()
+        if args.otlp:
+            from .telemetry import export_otlp
+
+            print(export_otlp(src, args.out, trace_id=args.trace_id,
+                              job=args.job))
+            return 0
         if isinstance(src, str) and is_service_dir(src):
+            if args.trace_id is not None:
+                # one trace is one request's causal slice across the
+                # journal and the job recorders — filter first, then
+                # the single-run exporter applies (same-host monotonic
+                # stamps; the OTLP export is the span-tree view)
+                import glob as _glob
+
+                from .telemetry.recorder import read_flight_events
+
+                evs = []
+                for p in sorted(_glob.glob(
+                        os.path.join(src, "*.jsonl"))):
+                    try:
+                        evs.extend(read_flight_events(p, offset=0)[0])
+                    except InvalidArgumentError:
+                        continue
+                print(export_chrome_trace(evs, args.out,
+                                          trace_id=args.trace_id))
+                return 0
             # a MeshScheduler flight dir: jobs are tenants, not mesh
             # processes — render one Perfetto track per job instead of
             # refusing the mixed run ids
@@ -796,7 +844,8 @@ def _cli(argv=None) -> int:
 
             print(export_service_trace(src, args.out))
             return 0
-        path = export_chrome_trace(src, args.out, run_id=args.run_id)
+        path = export_chrome_trace(src, args.out, run_id=args.run_id,
+                                   trace_id=args.trace_id)
         print(path)
         return 0
     if args.cmd == "stragglers":
@@ -869,6 +918,11 @@ def _render_watch(snap: dict) -> str:
     q = snap.get("queue") or {}
     sched = snap.get("scheduler") or {}
     hdr = f"igg watch  cursor={snap.get('cursor')}"
+    tail = snap.get("tail") or {}
+    if tail.get("lag_s") is not None:
+        # age of the newest merged event — a growing lag on a run that
+        # should be stepping means the tail (or the run) stalled
+        hdr += f"  lag={_fmt_s(tail['lag_s'])}"
     if sched:
         hdr += (f"  scheduler[slices={sched.get('slices')}"
                 f" draining={sched.get('draining')}]")
@@ -1013,6 +1067,36 @@ def _cli_alerts(args) -> int:
               f"{str(r['job'] or '-')[:12]:<12} "
               f"{str(r['state'])[:9]:<9} {str(r['severity'])[:9]:<9} "
               f"{r['transitions']:>3} {'yes' if r['acked'] else 'no':<3}")
+    return 0
+
+
+def _cli_flight(args) -> int:
+    """The ``flight du`` subcommand: per-stream on-disk sizes of a
+    flight directory, largest first — the CLI twin of the
+    ``igg_flight_file_bytes`` gauges the live tail stamps, so recorder
+    growth on a long-running service is one command away."""
+    import glob as _glob
+    import json
+    import os
+
+    rows = []
+    total = 0
+    for p in sorted(_glob.glob(os.path.join(args.flight_dir,
+                                            "*.jsonl"))):
+        try:
+            n = os.path.getsize(p)
+        except OSError:
+            continue  # rotated/removed between glob and stat
+        rows.append({"file": os.path.basename(p), "bytes": int(n)})
+        total += int(n)
+    rows.sort(key=lambda r: (-r["bytes"], r["file"]))
+    if args.json:
+        print(json.dumps({"dir": args.flight_dir, "files": rows,
+                          "total_bytes": total}))
+        return 0
+    for r in rows:
+        print(f"{r['bytes']:>12}  {r['file']}")
+    print(f"{total:>12}  total ({len(rows)} streams)")
     return 0
 
 

@@ -831,6 +831,64 @@ def test_telemetry_leaves_chunk_program_untouched(tmp_path):
     assert len(out_on) == len(out_off) == 3  # T, Cp, stats vector
 
 
+def test_tracing_leaves_chunk_program_untouched(tmp_path):
+    """THE ISSUE-20 wire claim: distributed tracing is host-side dict
+    stamping only — building and running the guarded chunk runner while
+    the active flight recorder carries a `TraceContext` (every record
+    stamped with the trace id and the job-root parent span) yields a
+    program with identical collective counts and an identical fetch
+    surface as untraced, and bit-identical outputs. The trace rides the
+    JSONL records, never the compiled program."""
+    from implicitglobalgrid_tpu.models import (
+        diffusion_step_local, init_diffusion3d,
+    )
+    from implicitglobalgrid_tpu.runtime.health import make_guarded_runner
+    from implicitglobalgrid_tpu.telemetry import (
+        TraceContext, flight_recorder, read_flight_events,
+        start_flight_recorder, stop_flight_recorder,
+    )
+
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2,
+                         periodx=1, periody=1, periodz=1, quiet=True)
+    T, Cp, p = init_diffusion3d(dtype=np.float32)
+
+    def step(s):
+        return (diffusion_step_local(s[0], s[1], p, "xla"), s[1])
+
+    off = make_guarded_runner(step, (3, 3), nt_chunk=4, key="hlo_tr_off")
+    ir_off = parse_program(off, T, Cp)
+    out_off = off(T, Cp)
+
+    tr = TraceContext.new().child()  # the job root, as the scheduler sets
+    start_flight_recorder(str(tmp_path / "fr.jsonl"))
+    try:
+        flight_recorder().trace = tr
+        igg.record_event("run_begin", nt=4)
+        on = make_guarded_runner(step, (3, 3), nt_chunk=4,
+                                 key="hlo_tr_on")
+        ir_on = parse_program(on, T, Cp)
+        out_on = on(T, Cp)
+        igg.record_event("chunk", chunk=0, step_begin=0, step_end=4,
+                         ok=True, exec_s=0.01)
+    finally:
+        path = stop_flight_recorder()
+
+    assert len(ir_on.permutes) == len(ir_off.permutes)
+    assert len(ir_on.all_reduces) == len(ir_off.all_reduces) == 1
+    assert not ir_on.all_gathers and not ir_on.all_to_alls
+    assert len(ir_on.parameters()) == len(ir_off.parameters())
+    for op in ("infeed", "outfeed"):
+        assert ir_on.count(op) == ir_off.count(op) == 0
+    for a, b in zip(out_on, out_off):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # ... and the trace really was live across the build + run
+    evs = read_flight_events(path)
+    stamped = [e for e in evs if e.get("kind") in ("run_begin", "chunk")]
+    assert len(stamped) == 2
+    assert all(e["trace_id"] == tr.trace_id
+               and e["parent_span_id"] == tr.span_id for e in stamped)
+
+
 def test_live_plane_leaves_chunk_program_untouched(tmp_path):
     """THE ISSUE-18 wire claim: the live observability plane is pure
     host-side tailing — building the guarded chunk runner while a

@@ -797,6 +797,126 @@ def test_alerts_fire_sink_cancels_bust_job_survivors_bit_identical(
 
 
 # ---------------------------------------------------------------------------
+# THE ISSUE-20 acceptance test: one traceparent, HTTP submit -> claim ->
+# admission -> slices -> flight chunks -> OTLP span tree
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serve
+@pytest.mark.service
+@pytest.mark.telemetry
+def test_traceparent_e2e_http_submit_to_otlp_span_tree(tmp_path):
+    """A client traceparent POSTed with a job is echoed on the response,
+    rides the queue record, roots the claiming scheduler's journal
+    (``job_claimed`` = the job's root span, parented on the API's submit
+    span), stamps every later journal event AND every flight chunk span
+    with the same trace id — and `export_otlp` reconstructs the whole
+    thing as ONE connected span tree. A second, headerless job gets a
+    fresh minted trace, and when an alert cancels it the ``control``
+    event's parent is the ALERT's span — causality across the
+    alert->sink->control-file->scheduler hop."""
+    from implicitglobalgrid_tpu.telemetry import (
+        TraceContext, export_otlp, read_flight_events,
+    )
+    from implicitglobalgrid_tpu.telemetry.live import ControlFileSink
+
+    d = str(tmp_path / "svc")
+    client = TraceContext.new()
+
+    with JobApiServer(d) as api:
+        u = f"http://{api.host}:{api.port}"
+        req = urllib.request.Request(
+            u + "/v1/jobs",
+            data=json.dumps(
+                {"jobs": [_record("tr1", deadline_s=3600.0)]}).encode(),
+            method="POST",
+            headers={"traceparent": client.to_traceparent()})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            code, rec = r.status, json.loads(r.read())
+            echoed = r.headers.get("traceparent")
+        assert code == 202 and rec["submitted"] == ["tr1"]
+        assert rec["traceparent"] == echoed
+        # the API span: same trace as the caller, its own span id
+        api_ctx = TraceContext.parse(echoed)
+        assert api_ctx.trace_id == client.trace_id
+        assert api_ctx.span_id != client.span_id
+
+        # no header -> a fresh trace is MINTED for the bust job
+        code, rec = _post(u + "/v1/jobs", {"jobs": [
+            _record("bust", deadline_s=3600.0,
+                    run={"nt_chunk": 4, "deadline_s": 1e-6})]})
+        assert code == 202
+        bust_tid = TraceContext.parse(rec["traceparent"]).trace_id
+        assert bust_tid != client.trace_id
+
+    sink = ControlFileSink(DirectoryBackend(d),
+                           rules=("deadline_slack_burn",))
+    with MeshScheduler(policy="round_robin", flight_dir=d, alerts=True,
+                       alert_sinks=(sink,)) as sched:
+        sched.run()
+        assert sched.job("tr1").state == JobState.DONE
+        assert sched.job("bust").state == JobState.CANCELLED
+
+    # -- the journal: every tr1 event shares the client's trace id ----------
+    tid = client.trace_id
+    journal = read_flight_events(os.path.join(d, "scheduler.jsonl"))
+    tr1 = [e for e in journal if e.get("job") == "tr1"]
+    assert tr1 and all(e.get("trace_id") == tid for e in tr1)
+    assert {"job_claimed", "job_submitted", "job_admitted",
+            "admission_priced", "slice", "job_done"} \
+        <= {e["kind"] for e in tr1}
+    claimed = [e for e in tr1 if e["kind"] == "job_claimed"]
+    assert len(claimed) == 1
+    # job_claimed IS the job's root span, child of the API's submit span
+    assert claimed[0]["parent_span_id"] == api_ctx.span_id
+    root = claimed[0]["span_id"]
+    for e in tr1:
+        if e["kind"] != "job_claimed":
+            assert (e["parent_span_id"], e["trace_id"]) == (root, tid)
+            assert e["span_id"] not in ("", root)
+
+    # -- the flight stream: chunk spans joined the SAME trace ---------------
+    flight = read_flight_events(os.path.join(d, "job_tr1.jsonl"))
+    chunks = [e for e in flight if e["kind"] == "chunk"]
+    assert chunks
+    for e in chunks:
+        assert (e["trace_id"], e["parent_span_id"]) == (tid, root)
+    # ... while the stream header stays untraced (schema unchanged)
+    assert flight[0]["kind"] == "recorder_open"
+    assert "trace_id" not in flight[0]
+
+    # -- alert->cancel causality on the bust trace --------------------------
+    alerts = [e for e in journal if e.get("kind") == "alert"
+              and e.get("job") == "bust"
+              and e.get("rule") == "deadline_slack_burn"]
+    assert alerts and all(e["trace_id"] == bust_tid for e in alerts)
+    controls = [e for e in journal if e.get("kind") == "control"
+                and e.get("job") == "bust"]
+    assert controls and controls[0]["trace_id"] == bust_tid
+    # the control event's parent IS the alert's span: "why was my job
+    # cancelled" is one parent walk back to the rule that fired
+    assert controls[0]["parent_span_id"] in {e["span_id"] for e in alerts}
+
+    # -- OTLP: ONE connected span tree from the HTTP request down -----------
+    doc = export_otlp(d, trace_id=tid)
+    spans = [s for rs in doc["resourceSpans"]
+             for ss in rs["scopeSpans"] for s in ss["spans"]]
+    assert {s["name"] for s in spans} >= {
+        "job_claimed", "admission_priced", "slice", "chunk", "job_done"}
+    ids = {s["spanId"] for s in spans}
+    assert len(ids) == len(spans)  # minted + synthesized: all unique
+    roots = [s for s in spans if s.get("parentSpanId") not in ids]
+    assert [s["name"] for s in roots] == ["job_claimed"]
+    assert roots[0]["parentSpanId"] == api_ctx.span_id
+    by_id = {s["spanId"]: s for s in spans}
+    for s in spans:  # every span's parent walk terminates at the claim
+        hops = 0
+        while s["spanId"] != roots[0]["spanId"]:
+            s = by_id[s["parentSpanId"]]
+            hops += 1
+            assert hops <= len(spans)
+
+
+# ---------------------------------------------------------------------------
 # Bearer-token auth (ISSUE 19 satellite): the routed ops surface
 # ---------------------------------------------------------------------------
 

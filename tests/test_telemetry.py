@@ -595,3 +595,305 @@ def test_finalize_resets_chronometer():
     igg.tic()
     igg.finalize_global_grid()
     assert timing._t0 is None
+
+
+# ---------------------------------------------------------------------------
+# Distributed tracing (ISSUE 20): TraceContext, recorder stamping, OTLP
+# ---------------------------------------------------------------------------
+
+def test_trace_context_parse_format_child_fields():
+    """The W3C traceparent round trip: mint, render, parse, derive."""
+    from implicitglobalgrid_tpu.telemetry import TraceContext
+
+    root = TraceContext.new()
+    assert len(root.trace_id) == 32 and len(root.span_id) == 16
+    assert root.parent_span_id is None and root.flags == "01"
+
+    hdr = root.to_traceparent()
+    assert re.fullmatch(
+        rf"00-{root.trace_id}-{root.span_id}-01", hdr)
+    back = TraceContext.parse(hdr)
+    assert back.trace_id == root.trace_id
+    assert back.span_id == root.span_id
+
+    # whitespace / case are normalized on parse
+    assert TraceContext.parse("  " + hdr.upper() + " ").span_id \
+        == root.span_id
+
+    kid = root.child()
+    assert kid.trace_id == root.trace_id
+    assert kid.parent_span_id == root.span_id
+    assert kid.span_id != root.span_id
+    assert kid.fields() == {"trace_id": root.trace_id,
+                            "span_id": kid.span_id,
+                            "parent_span_id": root.span_id}
+    assert root.fields() == {"trace_id": root.trace_id,
+                             "span_id": root.span_id}
+
+
+def test_trace_context_rejects_malformed():
+    from implicitglobalgrid_tpu.telemetry import TraceContext
+
+    good = TraceContext.new().to_traceparent()
+    for bad in ("", "nonsense", good[:-3],              # truncated
+                "ff" + good[2:],                        # reserved version
+                "00-" + "0" * 32 + good[35:],           # all-zero trace
+                good[:36] + "0" * 16 + good[52:],       # all-zero span
+                good.replace("-", "_")):
+        with pytest.raises(InvalidArgumentError):
+            TraceContext.parse(bad)
+    with pytest.raises(InvalidArgumentError):
+        TraceContext.parse(None)
+    with pytest.raises(InvalidArgumentError):
+        TraceContext(trace_id="xyz")
+    with pytest.raises(InvalidArgumentError):
+        TraceContext(trace_id="a" * 32, span_id="0" * 16)
+
+
+def test_flight_recorder_trace_stamping_off_is_byte_identical(tmp_path):
+    """THE zero-regression claim: an untraced recorder writes records
+    with NO trace keys at all (grep-level identical schema to every
+    prior release), and a traced one differs ONLY by the two stamp
+    keys — `recorder_open` stays untraced either way (it is emitted
+    before `.trace` can be assigned), proving the file header schema
+    never moved."""
+    from implicitglobalgrid_tpu.telemetry import (
+        FlightRecorder, TraceContext, read_flight_events,
+    )
+
+    def drive(rec):
+        rec.event("run_begin", nt=8)
+        rec.event("chunk", chunk=0, step_begin=0, step_end=4, ok=True,
+                  exec_s=0.25, build_s=0.5, n=4)
+        rec.event("guard_trip", chunk=0, reason="nonfinite")
+        rec.close()
+
+    p_off = tmp_path / "off.jsonl"
+    rec = FlightRecorder(str(p_off), run_id="tr_off")
+    drive(rec)
+    raw = p_off.read_text()
+    assert "trace_id" not in raw and "span_id" not in raw
+
+    tr = TraceContext.new().child()  # job root span, as the scheduler sets
+    p_on = tmp_path / "on.jsonl"
+    rec = FlightRecorder(str(p_on), run_id="tr_on")
+    rec.trace = tr
+    drive(rec)
+
+    off = read_flight_events(str(p_off))
+    on = read_flight_events(str(p_on))
+    assert [e["kind"] for e in off] == [e["kind"] for e in on]
+    for e_off, e_on in zip(off, on):
+        if e_on["kind"] == "recorder_open":
+            assert "trace_id" not in e_on  # pre-assignment: never traced
+            extra = set()
+        else:
+            assert e_on["trace_id"] == tr.trace_id
+            assert e_on["parent_span_id"] == tr.span_id
+            assert "span_id" not in e_on  # ids synthesized at export only
+            extra = {"trace_id", "parent_span_id"}
+        # the ONLY schema delta is the stamp itself
+        assert set(e_on) - set(e_off) == extra
+
+
+_TID = "0af7651916cd43dd8448eb211c80319c"
+_API = "b7ad6b7169203331"   # the serve tier's span (dangling parent)
+_ROOT = "00f067aa0ba902b7"  # job_claimed: the job's root span
+
+
+def _golden_trace_dir(tmp_path):
+    """Hand-written journal + flight streams of ONE traced job: the
+    deterministic fixture the OTLP goldens (and the trace CLI) decode."""
+    tid = _TID
+
+    def w(path, evs):
+        with open(path, "w", encoding="utf-8") as f:
+            for e in evs:
+                f.write(json.dumps(e) + "\n")
+
+    w(tmp_path / "journal.jsonl", [
+        {"kind": "recorder_open", "wall": 2000.0, "t": 100.0,
+         "run": "scheduler", "pid": 1, "proc": 0, "seq": 0},
+        {"kind": "job_claimed", "t": 101.0, "run": "scheduler",
+         "job": "j1", "owner": "sched-1", "trace_id": tid,
+         "span_id": _ROOT, "parent_span_id": _API,
+         "pid": 1, "proc": 0, "seq": 1},
+        {"kind": "admission_priced", "t": 102.0, "run": "scheduler",
+         "job": "j1", "price": 3, "trace_id": tid,
+         "span_id": "1111111111111111", "parent_span_id": _ROOT,
+         "pid": 1, "proc": 0, "seq": 2},
+        {"kind": "alert", "t": 103.0, "run": "scheduler", "job": "j1",
+         "rule": "deadline_slack_burn", "state": "firing",
+         "trace_id": tid, "span_id": "2222222222222222",
+         "parent_span_id": _ROOT, "pid": 1, "proc": 0, "seq": 3},
+        {"kind": "autoscale_decision", "t": 103.5, "run": "scheduler",
+         "job": "j1", "verdict": "grow", "trace_id": tid,
+         "span_id": "3333333333333333", "parent_span_id": _ROOT,
+         "pid": 1, "proc": 0, "seq": 4},
+        {"kind": "resize_requested", "t": 104.0, "run": "scheduler",
+         "job": "j1", "new_dims": [2, 2, 1], "trace_id": tid,
+         "span_id": "4444444444444444", "parent_span_id": _ROOT,
+         "pid": 1, "proc": 0, "seq": 5},
+        # a DIFFERENT job on the same journal: the job= filter's foil
+        {"kind": "job_claimed", "t": 105.0, "run": "scheduler",
+         "job": "other", "trace_id": "beef" * 8,
+         "span_id": "5555555555555555",
+         "pid": 1, "proc": 0, "seq": 6},
+    ])
+    w(tmp_path / "job_j1.jsonl", [
+        {"kind": "recorder_open", "wall": 1910.0, "t": 10.0,
+         "run": "j1", "pid": 2, "proc": 0, "seq": 0},
+        {"kind": "chunk", "t": 11.5, "run": "j1", "chunk": 0, "n": 4,
+         "exec_s": 1.0, "build_s": 0.5, "ok": True, "trace_id": tid,
+         "parent_span_id": _ROOT, "pid": 2, "proc": 0, "seq": 1},
+        {"kind": "guard_trip", "t": 11.75, "run": "j1", "chunk": 0,
+         "reason": "nonfinite", "trace_id": tid,
+         "parent_span_id": _ROOT, "pid": 2, "proc": 0, "seq": 2},
+        {"kind": "resize", "t": 12.0, "run": "j1", "dur_s": 0.25,
+         "new_dims": [2, 2, 1], "via": "disk", "trace_id": tid,
+         "parent_span_id": _ROOT, "pid": 2, "proc": 0, "seq": 3},
+        # untraced events vanish from the OTLP view entirely
+        {"kind": "run_end", "t": 12.5, "run": "j1", "completed": 8,
+         "pid": 2, "proc": 0, "seq": 4},
+    ])
+    return tmp_path
+
+
+def _all_spans(doc):
+    return [s for rs in doc["resourceSpans"]
+            for ss in rs["scopeSpans"] for s in ss["spans"]]
+
+
+def test_export_otlp_golden_span_tree(tmp_path):
+    """The OTLP encoder golden: exact wall-anchored nanosecond windows,
+    int64-as-string attributes, one resource per (run, proc), red-flag
+    kinds pinned as span EVENTS on their parent, the resize link, and a
+    parent-connected tree whose only dangling parent is the serve
+    tier's span."""
+    import hashlib
+
+    from implicitglobalgrid_tpu.telemetry import export_otlp
+
+    d = _golden_trace_dir(tmp_path)
+    doc = export_otlp(str(d), trace_id=_TID)
+
+    # resources: the scheduler journal and job j1's flight stream
+    services = {}
+    for rs in doc["resourceSpans"]:
+        attrs = {a["key"]: a["value"] for a in rs["resource"]["attributes"]}
+        services[attrs["igg.run"]["stringValue"]] = \
+            attrs["service.name"]["stringValue"]
+    assert services == {"scheduler": "igg-scheduler", "j1": "igg-job"}
+
+    spans = _all_spans(doc)
+    by_name = {s["name"]: s for s in spans}
+    assert set(by_name) == {"job_claimed", "admission_priced", "alert",
+                            "autoscale_decision", "resize_requested",
+                            "chunk", "guard_trip", "resize"}
+    assert all(s["traceId"] == _TID and s["kind"] == 1 for s in spans)
+
+    # exactly one root — the span whose parent is NOT in the export: the
+    # serve tier's request span, the one link out of the repo's streams
+    ids = {s["spanId"] for s in spans}
+    assert len(ids) == len(spans)
+    roots = [s for s in spans if s.get("parentSpanId") not in ids]
+    assert [s["name"] for s in roots] == ["job_claimed"]
+    assert roots[0]["spanId"] == _ROOT
+    assert roots[0]["parentSpanId"] == _API
+
+    # wall-anchored windows: journal anchor 2000-100=1900, flight anchor
+    # 1910-10=1900 — the chunk span backs off build+exec before its stamp
+    chunk = by_name["chunk"]
+    assert chunk["startTimeUnixNano"] == str(int(1910.0 * 1e9))
+    assert chunk["endTimeUnixNano"] == str(int(1911.5 * 1e9))
+    claimed = by_name["job_claimed"]
+    assert claimed["startTimeUnixNano"] == claimed["endTimeUnixNano"] \
+        == str(int(2001.0 * 1e9))
+    rz = by_name["resize"]
+    assert rz["startTimeUnixNano"] == str(int(1911.75 * 1e9))
+
+    # flight spans get the deterministic export-time id
+    want = hashlib.sha256(f"{_TID}:j1:0:1".encode()).hexdigest()[:16]
+    assert chunk["spanId"] == want
+
+    # attribute encoding: int64 as string, reserved keys dropped
+    priced = {a["key"]: a["value"]
+              for a in by_name["admission_priced"]["attributes"]}
+    assert priced["price"] == {"intValue": "3"}
+    assert priced["job"] == {"stringValue": "j1"}
+    assert "t" not in priced and "trace_id" not in priced
+    chunk_attrs = {a["key"]: a["value"] for a in chunk["attributes"]}
+    assert chunk_attrs["ok"] == {"boolValue": True}
+    assert chunk_attrs["exec_s"] == {"doubleValue": 1.0}
+
+    # red-flag kinds double as span events on the job root
+    ev_names = {e["name"] for e in claimed.get("events", ())}
+    assert {"alert", "autoscale_decision", "guard_trip"} <= ev_names
+
+    # the applied resize links back to the journal's resize_requested
+    links = rz.get("links", [])
+    assert len(links) == 1
+    assert links[0]["spanId"] == by_name["resize_requested"]["spanId"]
+    assert links[0]["attributes"] == [
+        {"key": "igg.link", "value": {"stringValue": "resize_requested"}}]
+
+
+def test_export_otlp_filters_and_errors(tmp_path):
+    from implicitglobalgrid_tpu.telemetry import export_otlp
+
+    d = _golden_trace_dir(tmp_path)
+
+    # job= filter: the foreign job's claim drops out
+    doc = export_otlp(str(d), job="j1")
+    assert all(s["traceId"] == _TID for s in _all_spans(doc))
+    # no filter: both traces present
+    tids = {s["traceId"] for s in _all_spans(export_otlp(str(d)))}
+    assert tids == {_TID, "beef" * 8}
+    # unknown trace / empty dir are typed errors, and out= writes a file
+    with pytest.raises(InvalidArgumentError):
+        export_otlp(str(d), trace_id="c0de" * 8)
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(InvalidArgumentError):
+        export_otlp(str(empty))
+    out = export_otlp(str(d), str(tmp_path / "o.json"), trace_id=_TID)
+    assert json.loads(open(out).read())["resourceSpans"]
+
+
+def test_otlp_exporter_batches_and_never_raises():
+    """The live sink: auto-flush at the batch size, failures counted
+    (never raised into the caller), untraced events ignored."""
+    from implicitglobalgrid_tpu.telemetry import OtlpSpanExporter
+
+    class Capture(OtlpSpanExporter):
+        def __init__(self, **kw):
+            super().__init__("http://collector.invalid/v1/traces", **kw)
+            self.bodies = []
+            self.boom = False
+
+        def _post(self, body):
+            if self.boom:
+                raise OSError("collector down")
+            self.bodies.append(json.loads(body.decode()))
+
+    exp = Capture(batch=2)
+    ev = {"kind": "slice", "t": 1.0, "run": "scheduler", "job": "j",
+          "trace_id": _TID, "span_id": "1212121212121212"}
+    exp.add(dict(ev, seq=0))
+    assert not exp.bodies  # below the batch size: buffered
+    exp.add({"kind": "slice", "t": 1.0})  # untraced: ignored entirely
+    exp(dict(ev, seq=1))  # __call__ alias — usable as a journal sink
+    assert len(exp.bodies) == 1 and exp.sent == 2
+    spans = _all_spans(exp.bodies[0])
+    assert len(spans) == 2 and spans[0]["traceId"] == _TID
+
+    exp.boom = True
+    exp.add(dict(ev, seq=2))
+    exp.close()  # flushes the short tail; the failure is counted
+    assert exp.failed == 1 and "collector down" in exp.last_error
+    assert len(exp.bodies) == 1  # nothing new landed
+
+    with pytest.raises(InvalidArgumentError):
+        OtlpSpanExporter("")
+    with pytest.raises(InvalidArgumentError):
+        OtlpSpanExporter("http://x", batch=0)
